@@ -1,0 +1,15 @@
+"""cometbft_tpu — a TPU-native BFT consensus framework.
+
+A brand-new framework with the capabilities of CometBFT (reference:
+ice-midas/cometbft): Tendermint BFT consensus, ABCI application interface,
+mempool, block/state sync, light client, evidence handling and JSON-RPC APIs —
+re-architected TPU-first. The cryptographic hot path (Ed25519 commit
+verification: point decompression, SHA-512, double-base scalar multiplication)
+runs as batched JAX/Pallas kernels on TPU behind the pluggable
+``crypto.BatchVerifier`` seam (reference: crypto/batch/batch.go:10,
+crypto/crypto.go:44-52); the consensus engine above it is backend-agnostic.
+"""
+
+from cometbft_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,10 @@
+"""Version constants (reference: version/version.go:1-18)."""
+
+# Semantic version of this framework.
+__version__ = "0.1.0"
+
+# Protocol versions. Block/P2P protocol numbers track the reference so that
+# genesis docs and headers carry comparable version metadata.
+BLOCK_PROTOCOL = 11
+P2P_PROTOCOL = 9
+ABCI_SEMVER = "2.2.0"

@@ -1,0 +1,105 @@
+"""Oracle sanity: cross-check the pure-Python Ed25519 against the independent
+`cryptography` implementation, plus ZIP-215 edge-case behavior."""
+
+import hashlib
+
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+)
+from cryptography.hazmat.primitives import serialization
+from cryptography.exceptions import InvalidSignature
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+
+
+def _lib_keypair(seed: bytes):
+    sk = Ed25519PrivateKey.from_private_bytes(seed)
+    pub = sk.public_key().public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return sk, pub
+
+
+def test_pubkey_matches_library():
+    for i in range(8):
+        seed = hashlib.sha256(b"seed%d" % i).digest()
+        _, pub = _lib_keypair(seed)
+        assert ref.pubkey_from_seed(seed) == pub
+
+
+def test_sign_verifies_with_library():
+    for i in range(8):
+        seed = hashlib.sha256(b"s%d" % i).digest()
+        sk, pub = _lib_keypair(seed)
+        msg = b"vote sign bytes %d" % i
+        sig = ref.sign(seed, msg)
+        sk.public_key().verify(sig, msg)  # raises on failure
+
+
+def test_library_sig_verifies_with_oracle():
+    for i in range(8):
+        seed = hashlib.sha256(b"t%d" % i).digest()
+        sk, pub = _lib_keypair(seed)
+        msg = b"message %d" % i
+        sig = sk.sign(msg)
+        assert ref.verify_zip215(pub, msg, sig)
+
+
+def test_bad_signature_rejected():
+    seed = hashlib.sha256(b"x").digest()
+    pub = ref.pubkey_from_seed(seed)
+    sig = bytearray(ref.sign(seed, b"hello"))
+    sig[0] ^= 1
+    assert not ref.verify_zip215(pub, b"hello", bytes(sig))
+    sig[0] ^= 1
+    assert ref.verify_zip215(pub, b"hello", bytes(sig))
+    assert not ref.verify_zip215(pub, b"hellp", bytes(sig))
+
+
+def test_noncanonical_s_rejected():
+    seed = hashlib.sha256(b"y").digest()
+    pub = ref.pubkey_from_seed(seed)
+    sig = ref.sign(seed, b"m")
+    s = int.from_bytes(sig[32:], "little")
+    bad = sig[:32] + (s + ref.L).to_bytes(32, "little")
+    assert not ref.verify_zip215(pub, b"m", bad)
+
+
+def test_small_order_pubkey_accepted_zip215():
+    # The identity point compresses to y=1; a signature by the zero scalar
+    # over any message with R = identity and s = 0 satisfies the cofactored
+    # equation: 8*0*B == 8*I + 8*h*I.  ZIP-215 accepts this.
+    ident = ref.pt_compress(ref.IDENTITY)
+    sig = ident + (0).to_bytes(32, "little")
+    assert ref.verify_zip215(ident, b"anything", sig)
+
+
+def test_noncanonical_y_accepted_zip215():
+    # Encode y = p + 1 (non-canonical encoding of y=1, the identity).  ZIP-215
+    # explicitly accepts encodings with y >= p.
+    enc = (ref.P + 1).to_bytes(32, "little")
+    assert ref.pt_decompress_zip215(enc) is not None
+    sig = enc + (0).to_bytes(32, "little")
+    assert ref.verify_zip215(enc, b"msg", sig)
+
+
+def test_decompress_rejects_nonsquare():
+    # y = 2: u/v is not a square for edwards25519 (known non-point).
+    count_fail = 0
+    for y in range(2, 40):
+        if ref.pt_decompress_zip215(y.to_bytes(32, "little")) is None:
+            count_fail += 1
+    assert count_fail > 0  # plenty of non-points in range
+
+
+def test_point_roundtrip():
+    for k in [1, 2, 3, 5, 8, 1000, ref.L - 1]:
+        pt = ref.pt_mul(k, ref.BASE)
+        assert ref.pt_equal(ref.pt_decompress_zip215(ref.pt_compress(pt)), pt)
+
+
+def test_cofactor_kills_small_order_component():
+    # 8 * (any small-order point) == identity.
+    ident8 = ref.pt_mul(8, ref.pt_decompress_zip215((ref.P + 1).to_bytes(32, "little")))
+    assert ref.pt_is_identity(ident8)
