@@ -1,0 +1,164 @@
+"""Consensus write-ahead log (reference: internal/consensus/wal.go:59-135).
+
+Every consensus input is written to the WAL before being processed; internal
+messages are fsync'd (WriteSync) so a crashed node can deterministically
+replay to its exact pre-crash state.  Records are CRC32 + length framed, and
+``#ENDHEIGHT <h>`` markers delimit heights (reference: wal.go EndHeightMessage,
+WALEncoder).
+
+File rotation follows the autofile.Group design (reference:
+internal/autofile/group.go): head file plus numbered rolled files.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+MAX_MSG_SIZE = 1 << 20  # 1 MB per WAL record
+_REC_DATA = 1
+_REC_END_HEIGHT = 2
+
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024
+
+
+@dataclass
+class WALRecord:
+    kind: int
+    payload: bytes  # for END_HEIGHT: 8-byte big-endian height
+
+    @property
+    def end_height(self) -> Optional[int]:
+        if self.kind == _REC_END_HEIGHT:
+            return int.from_bytes(self.payload, "big")
+        return None
+
+
+def _frame(kind: int, payload: bytes) -> bytes:
+    body = bytes([kind]) + payload
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return struct.pack(">II", crc, len(body)) + body
+
+
+class WALCorruptionError(Exception):
+    pass
+
+
+class WAL:
+    """Reference: internal/consensus/wal.go BaseWAL."""
+
+    def __init__(self, path: str, head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT):
+        self.path = path
+        self.head_size_limit = head_size_limit
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    # -- writing ----------------------------------------------------------
+
+    def write(self, payload: bytes) -> None:
+        """Buffered write (peer messages; reference: state.go:842)."""
+        if len(payload) > MAX_MSG_SIZE:
+            raise ValueError("WAL message too large")
+        self._f.write(_frame(_REC_DATA, payload))
+        self._maybe_rotate()
+
+    def write_sync(self, payload: bytes) -> None:
+        """Write + flush + fsync (internal messages; reference: state.go:850)."""
+        self.write(payload)
+        self.flush_and_sync()
+
+    def write_end_height(self, height: int) -> None:
+        """#ENDHEIGHT marker, fsync'd (reference: state.go:1904)."""
+        self._f.write(_frame(_REC_END_HEIGHT, height.to_bytes(8, "big")))
+        self.flush_and_sync()
+        self._maybe_rotate()
+
+    def flush_and_sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def _maybe_rotate(self) -> None:
+        if self._f.tell() < self.head_size_limit:
+            return
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        idx = 0
+        while os.path.exists(f"{self.path}.{idx:03d}"):
+            idx += 1
+        os.rename(self.path, f"{self.path}.{idx:03d}")
+        self._f = open(self.path, "ab")
+
+    def close(self) -> None:
+        try:
+            self.flush_and_sync()
+        except (OSError, ValueError):
+            pass
+        self._f.close()
+
+    # -- reading / replay -------------------------------------------------
+
+    def _files(self) -> list[str]:
+        """All WAL files, oldest first, head last."""
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path)
+        rolled = sorted(
+            f for f in os.listdir(d) if f.startswith(base + ".") and f[-3:].isdigit()
+        )
+        out = [os.path.join(d, f) for f in rolled]
+        if os.path.exists(self.path):
+            out.append(self.path)
+        return out
+
+    def iter_records(self, strict: bool = True) -> Iterator[WALRecord]:
+        self._f.flush()
+        for fp in self._files():
+            with open(fp, "rb") as f:
+                while True:
+                    hdr = f.read(8)
+                    if not hdr:
+                        break
+                    if len(hdr) < 8:
+                        if strict:
+                            raise WALCorruptionError("truncated record header")
+                        return
+                    crc, length = struct.unpack(">II", hdr)
+                    if length > MAX_MSG_SIZE + 1:
+                        if strict:
+                            raise WALCorruptionError("record too large")
+                        return
+                    body = f.read(length)
+                    if len(body) < length:
+                        if strict:
+                            raise WALCorruptionError("truncated record body")
+                        return
+                    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                        if strict:
+                            raise WALCorruptionError("crc mismatch")
+                        return
+                    yield WALRecord(kind=body[0], payload=body[1:])
+
+    def search_for_end_height(self, height: int) -> bool:
+        """True if an #ENDHEIGHT marker for `height` exists
+        (reference: wal.go SearchForEndHeight)."""
+        for rec in self.iter_records(strict=False):
+            if rec.end_height == height:
+                return True
+        return False
+
+    def replay_after_height(self, height: int) -> list[bytes]:
+        """All data records written after #ENDHEIGHT(height) — the inputs to
+        replay on restart (reference: replay.go catchupReplay)."""
+        out: list[bytes] = []
+        found = False
+        for rec in self.iter_records(strict=False):
+            if not found:
+                if rec.end_height == height:
+                    found = True
+                continue
+            if rec.kind == _REC_DATA:
+                out.append(rec.payload)
+        return out if found else []

@@ -1,0 +1,95 @@
+"""Key interfaces and the Ed25519 implementation.
+
+Mirrors the reference seam (crypto/crypto.go:22-52): ``PubKey`` /
+``PrivKey`` with 20-byte SHA-256-truncated addresses, plus a key-type
+registry used by genesis validation (reference: internal/keytypes).
+
+Single verification uses a two-tier strategy: the C-speed `cryptography`
+library first (strict RFC 8032 — acceptance there implies ZIP-215
+acceptance, since the cofactorless equation implies the cofactored one),
+falling back to the pure-Python ZIP-215 oracle for the edge cases the
+strict verifier rejects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric import ed25519 as _lib_ed25519
+
+from cometbft_tpu.crypto import ed25519_ref, tmhash
+
+ED25519_KEY_TYPE = "ed25519"
+SECP256K1_KEY_TYPE = "secp256k1"
+BLS12381_KEY_TYPE = "bls12_381"
+
+
+@dataclass(frozen=True)
+class Ed25519PubKey:
+    data: bytes  # 32-byte compressed point
+
+    type_ = ED25519_KEY_TYPE
+
+    def __post_init__(self):
+        if len(self.data) != 32:
+            raise ValueError("ed25519 pubkey must be 32 bytes")
+
+    def address(self) -> bytes:
+        # memoized: address() sits on hot paths (validator lookups, proposer
+        # rotation) — bypass the frozen-dataclass setattr via __dict__.
+        addr = self.__dict__.get("_addr")
+        if addr is None:
+            addr = tmhash.sum_truncated(self.data)
+            self.__dict__["_addr"] = addr
+        return addr
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != 64:
+            return False
+        try:
+            _lib_ed25519.Ed25519PublicKey.from_public_bytes(self.data).verify(
+                sig, msg
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            # Strict verifier rejected: may still be ZIP-215-valid
+            # (small-order / non-canonical encodings, cofactored equation).
+            return ed25519_ref.verify_zip215(self.data, msg, sig)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+
+@dataclass(frozen=True)
+class Ed25519PrivKey:
+    seed: bytes  # 32-byte seed
+
+    type_ = ED25519_KEY_TYPE
+
+    @staticmethod
+    def generate() -> "Ed25519PrivKey":
+        return Ed25519PrivKey(ed25519_ref.generate_seed())
+
+    @staticmethod
+    def from_seed(seed: bytes) -> "Ed25519PrivKey":
+        return Ed25519PrivKey(seed)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(ed25519_ref.pubkey_from_seed(self.seed))
+
+    def sign(self, msg: bytes) -> bytes:
+        return ed25519_ref.sign(self.seed, msg)
+
+    def bytes(self) -> bytes:
+        return self.seed
+
+
+def pub_key_from_type(key_type: str, data: bytes):
+    if key_type == ED25519_KEY_TYPE:
+        return Ed25519PubKey(data)
+    raise ValueError(f"unsupported key type: {key_type}")
+
+
+def supported_key_types() -> list[str]:
+    return [ED25519_KEY_TYPE]

@@ -1,0 +1,151 @@
+"""Batched edwards25519 point arithmetic in JAX (extended coordinates).
+
+A batch of points is a 4-tuple (X, Y, Z, T) of (20, B) limb arrays (see
+``fe25519``), T = XY/Z.  Formulas are the unified/complete ones from
+RFC 8032 section 5.1.4 — complete for *all* curve points (including the small
+-order points that ZIP-215 verification must handle), so every step of the
+scalar-multiplication ladder is branch-free: ideal for XLA.
+
+Reference behavior being reproduced: the double-base scalar multiplication
+inside curve25519-voi batch verification (crypto/ed25519/ed25519.go:189-222
+pulls it in; SURVEY.md §3.4 maps the call stack).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from cometbft_tpu.ops import fe25519 as fe
+
+
+class PointBatch(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+# Curve constants as python ints (derived, not copied: standard edwards25519).
+D_INT = (-121665 * pow(121666, fe.P_INT - 2, fe.P_INT)) % fe.P_INT
+D2_INT = 2 * D_INT % fe.P_INT
+
+_BY = 4 * pow(5, fe.P_INT - 2, fe.P_INT) % fe.P_INT
+# Recover base-point x with even parity (RFC 8032 5.1).
+_u = (_BY * _BY - 1) % fe.P_INT
+_v = (D_INT * _BY * _BY + 1) % fe.P_INT
+_x = (_u * pow(_v, 3, fe.P_INT)) % fe.P_INT * pow(
+    (_u * pow(_v, 7, fe.P_INT)) % fe.P_INT, (fe.P_INT - 5) // 8, fe.P_INT
+) % fe.P_INT
+if (_v * _x * _x - _u) % fe.P_INT != 0:
+    _x = _x * pow(2, (fe.P_INT - 1) // 4, fe.P_INT) % fe.P_INT
+if _x & 1:
+    _x = fe.P_INT - _x
+BASE_X, BASE_Y = _x, _BY
+
+
+def identity(batch: int) -> PointBatch:
+    zero = jnp.zeros((fe.NLIMBS, batch), jnp.int32)
+    one = jnp.broadcast_to(fe.const(1), (fe.NLIMBS, batch))
+    return PointBatch(zero, one, one, zero)
+
+
+def base_point(batch: int) -> PointBatch:
+    x = jnp.broadcast_to(fe.const(BASE_X), (fe.NLIMBS, batch))
+    y = jnp.broadcast_to(fe.const(BASE_Y), (fe.NLIMBS, batch))
+    one = jnp.broadcast_to(fe.const(1), (fe.NLIMBS, batch))
+    t = jnp.broadcast_to(fe.const(BASE_X * BASE_Y % fe.P_INT), (fe.NLIMBS, batch))
+    return PointBatch(x, y, one, t)
+
+
+def add(p: PointBatch, q: PointBatch) -> PointBatch:
+    a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
+    b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
+    c = fe.mul(fe.mul(p.t, q.t), jnp.broadcast_to(fe.const(D2_INT), p.t.shape))
+    d = fe.mul(fe.add(p.z, p.z), q.z)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    return PointBatch(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def double(p: PointBatch) -> PointBatch:
+    a = fe.square(p.x)
+    b = fe.square(p.y)
+    c = fe.add(fe.square(p.z), fe.square(p.z))
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.square(fe.add(p.x, p.y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    return PointBatch(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
+
+
+def negate(p: PointBatch) -> PointBatch:
+    return PointBatch(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
+
+
+def select4(sel: jnp.ndarray, tbl: list[PointBatch]) -> PointBatch:
+    """Branch-free 4-way table lookup: sel (B,) int32 in {0..3}.
+
+    Implemented as a one-hot weighted sum — no gather, pure VPU mul/add,
+    constant-time across lanes."""
+    coords = []
+    for k in range(4):
+        oh = (sel == k).astype(jnp.int32)[None, :]  # (1, B)
+        coords.append(tuple(c * oh for c in tbl[k]))
+    out = tuple(
+        coords[0][i] + coords[1][i] + coords[2][i] + coords[3][i] for i in range(4)
+    )
+    return PointBatch(*out)
+
+
+def double_base_scalar_mul(
+    bits_s: jnp.ndarray, bits_m: jnp.ndarray, a: PointBatch
+) -> PointBatch:
+    """Compute s*B + m*A jointly (Straus/Shamir ladder).
+
+    bits_s, bits_m: (253, B) int32, MSB first.  Per bit: one doubling and one
+    complete addition of a 4-entry table {O, B, A, B+A} selected branch-free.
+    """
+    batch = bits_s.shape[1]
+    tbl = [identity(batch), base_point(batch), a, add(base_point(batch), a)]
+
+    def body(p, bits):
+        bs, bm = bits
+        p = double(p)
+        p = add(p, select4(bs + 2 * bm, tbl))
+        return p, None
+
+    p0 = identity(batch)
+    p, _ = lax.scan(body, p0, (bits_s, bits_m))
+    return p
+
+
+def is_identity(p: PointBatch) -> jnp.ndarray:
+    """(B,) bool; Z is nonzero for every output of the complete formulas."""
+    return fe.is_zero(p.x) & fe.eq(p.y, p.z)
+
+
+def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
+    """ZIP-215 point decompression on-device.
+
+    y_limbs: (20, B) limbs of the 255-bit y field (sign bit already stripped;
+    non-canonical y >= p accepted).  sign: (B,) int32 in {0, 1}.
+    Returns (ok, PointBatch).
+    """
+    one = jnp.broadcast_to(fe.const(1), y_limbs.shape)
+    y2 = fe.square(y_limbs)
+    u = fe.sub(y2, one)
+    v = fe.add(fe.mul(y2, jnp.broadcast_to(fe.const(D_INT), y2.shape)), one)
+    ok, x = fe.sqrt_ratio(u, v)
+    x = fe.freeze(x)
+    # Normalize to the even root, then apply the sign bit (-0 stays 0:
+    # non-canonical sign encodings are accepted, matching ZIP-215).
+    odd = (x[0] & 1) == 1
+    x = fe.select(odd, fe.neg(x), x)
+    x = fe.select(sign == 1, fe.neg(x), x)
+    return ok, PointBatch(x, y_limbs, one, fe.mul(x, y_limbs))

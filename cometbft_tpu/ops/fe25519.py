@@ -1,0 +1,241 @@
+"""Batched GF(2^255-19) field arithmetic in JAX, designed for TPU.
+
+Layout: a batch of field elements is an int32 array of shape ``(20, B)`` —
+20 little-endian limbs of 13 bits each (values in ``[0, 2^13)``), batch last.
+Limbs-first puts the batch on the TPU lane dimension (128-wide VPU lanes), so
+every limb operation is a full-width vector op; the 20-limb axis lives on
+sublanes.
+
+Why 13-bit limbs: schoolbook products ``a_i * b_j`` are < 2^26 and a 39-column
+accumulation stays < 20 * 2^26 < 2^31, so the whole multiplier runs in native
+int32 with no 64-bit emulation — the TPU has no fast u64 path.  (The reference
+gets this arithmetic from curve25519-voi's platform assembly; here it is
+re-derived for the TPU's integer units.  Reference seam:
+crypto/ed25519/ed25519.go:189-222.)
+
+Values are kept *partially reduced* (any 13-bit limb pattern, i.e. < 2^260,
+congruent mod p); ``freeze`` produces the canonical representative for
+comparisons and encoding.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 20
+BITS = 13
+MASK = (1 << BITS) - 1
+P_INT = 2**255 - 19
+# 2^260 = 2^5 * 2^255 ≡ 32 * 19 (mod p): the fold factor for limb overflow.
+FOLD = 19 * 32  # 608
+# 2^255 ≡ 19: fold factor for bits 255..259 inside limb 19.
+TOP_FOLD = 19
+
+
+def limbs_of_int(n: int) -> np.ndarray:
+    """Host helper: python int -> (20,) int32 limb vector."""
+    out = np.zeros(NLIMBS, np.int32)
+    for i in range(NLIMBS):
+        out[i] = n & MASK
+        n >>= BITS
+    assert n == 0, "value does not fit in 20x13 bits"
+    return out
+
+
+def int_of_limbs(x: np.ndarray) -> int:
+    """Host helper: (20,) limbs -> python int (no reduction)."""
+    n = 0
+    for i in reversed(range(NLIMBS)):
+        n = (n << BITS) | int(x[i])
+    return n
+
+
+_P_LIMBS = limbs_of_int(P_INT)
+# 32p expressed so that limb-wise (a + C - b) only dips negative in limb 0,
+# which the signed (floor) carry chain absorbs.  32p = 2^260 - 608.
+_SUB_PAD = np.full(NLIMBS, MASK, np.int32)
+_SUB_PAD[0] = MASK - (2**260 - 1 - (32 * P_INT))
+assert int_of_limbs(_SUB_PAD) == 32 * P_INT
+
+
+def const(n: int, batch: int | None = None) -> jnp.ndarray:
+    """A field constant, shape (20, 1) broadcastable over the batch."""
+    limbs = limbs_of_int(n % P_INT)
+    if batch is None:
+        return jnp.asarray(limbs[:, None], jnp.int32)
+    return jnp.broadcast_to(jnp.asarray(limbs[:, None], jnp.int32), (NLIMBS, batch))
+
+
+def bytes_to_limbs(data: np.ndarray) -> np.ndarray:
+    """Host helper: (B, 32) uint8 little-endian -> (20, B) int32 limbs.
+
+    Takes all 256 bits; callers mask bit 255 (the sign bit) beforehand if
+    needed.  Values >= p are fine — arithmetic is on partially-reduced forms.
+    """
+    bits = np.unpackbits(data, axis=1, bitorder="little").astype(np.int64)  # (B,256)
+    out = np.zeros((NLIMBS, data.shape[0]), np.int64)
+    w = (1 << np.arange(BITS)).astype(np.int64)
+    for i in range(NLIMBS):
+        seg = bits[:, BITS * i : min(BITS * (i + 1), 256)]
+        out[i] = seg @ w[: seg.shape[1]]
+    return out.astype(np.int32)
+
+
+def limbs_to_bytes(x: np.ndarray) -> np.ndarray:
+    """Host helper: (20, B) canonical limbs -> (B, 32) uint8 little-endian."""
+    B = x.shape[1]
+    bits = np.zeros((B, 260), np.uint8)
+    for i in range(NLIMBS):
+        v = x[i].astype(np.int64)
+        for j in range(BITS):
+            bits[:, BITS * i + j] = (v >> j) & 1
+    return np.packbits(bits[:, :256], axis=1, bitorder="little")
+
+
+# ---------------------------------------------------------------------------
+# Device ops.  All take/return (20, B) int32 with limbs in [0, 2^13).
+# ---------------------------------------------------------------------------
+
+def _carry(x: jnp.ndarray) -> jnp.ndarray:
+    """Signed carry propagation + top fold over a (20, B) array whose limbs
+    may exceed 13 bits (|limb| < 2^30).  Two passes guarantee convergence for
+    the bounds produced by add/sub/mul."""
+    for _ in range(2):
+        rows = [x[i] for i in range(NLIMBS)]
+        carry = None
+        for i in range(NLIMBS):
+            if carry is not None:
+                rows[i] = rows[i] + carry
+            carry = rows[i] >> BITS  # arithmetic shift: floor semantics
+            rows[i] = rows[i] - (carry << BITS)
+        rows[0] = rows[0] + FOLD * carry  # 2^260 ≡ 608 (mod p)
+        x = jnp.stack(rows)
+    return x
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    pad = jnp.asarray(_SUB_PAD[:, None], jnp.int32)
+    return _carry(a + pad - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    pad = jnp.asarray(_SUB_PAD[:, None], jnp.int32)
+    return _carry(pad - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 20x20 -> 39 columns, fold, carry."""
+    B = jnp.broadcast_shapes(a.shape, b.shape)[1]
+    ncols = 2 * NLIMBS - 1  # 39 product columns
+    cols = [jnp.zeros((B,), jnp.int32) for _ in range(ncols)]
+    for i in range(NLIMBS):
+        prod = a[i][None, :] * b  # (20, B); each term < 2^26
+        for j in range(NLIMBS):
+            cols[i + j] = cols[i + j] + prod[j]
+    # Carry-propagate the 39 columns; the final carry is the (unmasked) value
+    # of virtual column 39 (< 2^14), folded below.
+    carry = None
+    for i in range(ncols):
+        if carry is not None:
+            cols[i] = cols[i] + carry
+        carry = cols[i] >> BITS
+        cols[i] = cols[i] - (carry << BITS)
+    # Fold columns 20..39 down with 2^260 ≡ 608.
+    rows = []
+    for i in range(NLIMBS):
+        hi = cols[i + NLIMBS] if i + NLIMBS < ncols else carry
+        rows.append(cols[i] + FOLD * hi)
+    return _carry(jnp.stack(rows))
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def freeze(x: jnp.ndarray) -> jnp.ndarray:
+    """Canonical representative in [0, p): fold bits >= 255, then one
+    conditional subtract of p."""
+    x = _carry(x)
+    hi = x[NLIMBS - 1] >> (255 - BITS * (NLIMBS - 1))  # bits 255..259 of value
+    rows = [x[i] for i in range(NLIMBS)]
+    rows[NLIMBS - 1] = rows[NLIMBS - 1] - (hi << (255 - BITS * (NLIMBS - 1)))
+    rows[0] = rows[0] + TOP_FOLD * hi
+    carry = None
+    for i in range(NLIMBS):
+        if carry is not None:
+            rows[i] = rows[i] + carry
+        carry = rows[i] >> BITS
+        rows[i] = rows[i] - (carry << BITS)
+    # value now < 2^255 + small => at most one subtract of p needed.
+    p = jnp.asarray(_P_LIMBS[:, None], jnp.int32)
+    y = [rows[i] - p[i] for i in range(NLIMBS)]
+    borrow = None
+    for i in range(NLIMBS):
+        if borrow is not None:
+            y[i] = y[i] + borrow
+        borrow = y[i] >> BITS
+        y[i] = y[i] - (borrow << BITS)
+    take_y = borrow == 0  # x >= p
+    return jnp.stack([jnp.where(take_y, y[i], rows[i]) for i in range(NLIMBS)])
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(B,) bool: a == b mod p."""
+    return jnp.all(freeze(sub(a, b)) == 0, axis=0)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """(B,) int32: LSB of the canonical representative."""
+    return freeze(a)[0] & 1
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane select: cond (B,) bool -> limbs from a else b."""
+    return jnp.where(cond[None, :], a, b)
+
+
+def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """x^exponent for a compile-time-constant exponent, MSB-first
+    square-and-multiply driven by lax.scan (trace stays 2 muls)."""
+    nbits = exponent.bit_length()
+    bits = jnp.asarray(
+        [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], jnp.int32
+    )
+    one = jnp.broadcast_to(const(1), x.shape)
+
+    def body(acc, bit):
+        acc = square(acc)
+        acc = jnp.where(bit == 1, mul(acc, x), acc)  # scalar cond broadcasts
+        return acc, None
+
+    acc, _ = lax.scan(body, one, bits)
+    return acc
+
+
+_SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
+
+
+def sqrt_ratio(u: jnp.ndarray, v: jnp.ndarray):
+    """Return (ok, x) with x = sqrt(u/v) where it exists (the even root is not
+    selected here — callers normalize parity).  ok is (B,) bool."""
+    v3 = mul(square(v), v)
+    v7 = mul(square(v3), v)
+    r = pow_fixed(mul(u, v7), (P_INT - 5) // 8)
+    x = mul(mul(u, v3), r)
+    vx2 = mul(v, square(x))
+    ok1 = eq(vx2, u)
+    ok2 = eq(vx2, neg(u))
+    sqrt_m1 = const(_SQRT_M1_INT)
+    x = select(ok2, mul(x, jnp.broadcast_to(sqrt_m1, x.shape)), x)
+    return ok1 | ok2, x

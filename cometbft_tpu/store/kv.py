@@ -1,0 +1,159 @@
+"""Embedded key-value store abstraction (reference: cometbft-db dependency).
+
+Backends: in-memory (tests) and SQLite (durable default — stdlib, crash-safe
+WAL journaling; the reference defaults to goleveldb/pebble, SURVEY.md §2.1.3).
+Iteration is ordered by raw bytes, matching the reference's iterator contract.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from bisect import bisect_left, insort
+from typing import Iterator, Optional
+
+
+class KVStore:
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def iterate(
+        self, start: bytes = b"", end: Optional[bytes] = None
+    ) -> Iterator[tuple[bytes, bytes]]:
+        """Ordered iteration over [start, end)."""
+        raise NotImplementedError
+
+    def write_batch(self, sets: list[tuple[bytes, bytes]], deletes: list[bytes]):
+        for k, v in sets:
+            self.set(k, v)
+        for k in deletes:
+            self.delete(k)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemKV(KVStore):
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._data:
+                insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                i = bisect_left(self._keys, key)
+                del self._keys[i]
+
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None):
+        with self._lock:
+            i = bisect_left(self._keys, start)
+            keys = []
+            while i < len(self._keys):
+                k = self._keys[i]
+                if end is not None and k >= end:
+                    break
+                keys.append(k)
+                i += 1
+        for k in keys:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+
+class SqliteKV(KVStore):
+    """Durable KV over SQLite with WAL journaling."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+            )
+            self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return bytes(row[0]) if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (key, value),
+            )
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None):
+        with self._lock:
+            if end is None:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (start,)
+                ).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                    (start, end),
+                ).fetchall()
+        for k, v in rows:
+            yield bytes(k), bytes(v)
+
+    def write_batch(self, sets, deletes):
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO kv (k, v) VALUES (?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                sets,
+            )
+            self._conn.executemany(
+                "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
+            )
+            self._conn.commit()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def open_kv(backend: str, path: Optional[str] = None) -> KVStore:
+    if backend == "memdb":
+        return MemKV()
+    if backend == "sqlite":
+        if not path:
+            raise ValueError("sqlite backend requires a path")
+        return SqliteKV(path)
+    raise ValueError(f"unknown db backend: {backend}")

@@ -1,0 +1,1 @@
+"""Core consensus types (reference: types/)."""

@@ -1,0 +1,212 @@
+"""Block, Header, Data, Commit (reference: types/block.go).
+
+Header.hash() is the merkle root over the proto-encoded header fields
+(reference: types/block.go Header.Hash); Commit carries one CommitSig per
+validator in validator-set order, and VoteSignBytes reconstructs the exact
+canonical vote each validator signed (reference: types/block.go:901).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cometbft_tpu.crypto import merkle, tmhash
+from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.types.basic import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    PRECOMMIT_TYPE,
+    BlockID,
+    PartSetHeader,
+    Timestamp,
+)
+from cometbft_tpu.types.canonical import canonical_vote_sign_bytes
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.types.vote import CommitSig
+
+
+@dataclass(frozen=True)
+class ConsensusVersion:
+    """Proto Consensus{block, app} version pair."""
+
+    block: int
+    app: int = 0
+
+    def encode(self) -> bytes:
+        return pe.t_varint(1, self.block) + pe.t_varint(2, self.app)
+
+
+@dataclass
+class Header:
+    version: ConsensusVersion
+    chain_id: str
+    height: int
+    time: Timestamp
+    last_block_id: BlockID
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> bytes:
+        """Merkle root over the proto encodings of each field, in order."""
+        if not self.validators_hash:
+            return b""
+        fields = [
+            self.version.encode(),
+            self.chain_id.encode(),
+            pe.uvarint(self.height),
+            self.time.encode(),
+            self.last_block_id.encode(),
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ]
+        return merkle.hash_from_byte_slices(fields)
+
+    def validate_basic(self) -> str | None:
+        if not self.chain_id or len(self.chain_id) > 50:
+            return "invalid chain id"
+        if self.height < 0:
+            return "negative height"
+        if self.proposer_address and len(self.proposer_address) != 20:
+            return "invalid proposer address"
+        return None
+
+
+@dataclass
+class Data:
+    txs: list[bytes] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(list(self.txs))
+
+
+@dataclass
+class Commit:
+    height: int
+    round_: int
+    block_id: BlockID
+    signatures: list[CommitSig]
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Reconstruct the canonical sign bytes of validator idx's precommit
+        (reference: types/block.go:901 -> vote.go:151 -> canonical.go:57)."""
+        cs = self.signatures[idx]
+        block_id = self.block_id if cs.block_id_flag == BLOCK_ID_FLAG_COMMIT else None
+        return canonical_vote_sign_bytes(
+            chain_id,
+            PRECOMMIT_TYPE,
+            self.height,
+            self.round_,
+            block_id,
+            cs.timestamp,
+        )
+
+    def hash(self) -> bytes:
+        items = []
+        for cs in self.signatures:
+            # must match codec.encode_commit_sig exactly (proto encoding)
+            items.append(
+                pe.t_varint(1, cs.block_id_flag)
+                + pe.t_bytes(2, cs.validator_address)
+                + pe.t_message(3, cs.timestamp.encode())
+                + pe.t_bytes(4, cs.signature)
+            )
+        return merkle.hash_from_byte_slices(items)
+
+    def validate_basic(self) -> str | None:
+        if self.height < 0:
+            return "negative height"
+        if self.round_ < 0:
+            return "negative round"
+        if self.height >= 1:
+            if self.block_id.is_zero():
+                return "commit cannot be for nil block"
+            if not self.signatures:
+                return "no signatures in commit"
+        for cs in self.signatures:
+            if cs.block_id_flag not in (
+                BLOCK_ID_FLAG_ABSENT,
+                BLOCK_ID_FLAG_COMMIT,
+                BLOCK_ID_FLAG_NIL,
+            ):
+                return "invalid block id flag"
+            if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+                if cs.validator_address or cs.signature:
+                    return "absent signature with data"
+            else:
+                if len(cs.validator_address) != 20:
+                    return "invalid validator address"
+                if not cs.signature or len(cs.signature) > 96:
+                    return "invalid signature size"
+        return None
+
+
+def empty_commit() -> Commit:
+    return Commit(height=0, round_=0, block_id=BlockID(), signatures=[])
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data
+    last_commit: Commit
+    evidence: list = field(default_factory=list)
+
+    def fill_header_hashes(self) -> None:
+        if not self.header.last_commit_hash:
+            self.header.last_commit_hash = self.last_commit.hash()
+        if not self.header.data_hash:
+            self.header.data_hash = self.data.hash()
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = merkle.hash_from_byte_slices(
+                [ev.hash() for ev in self.evidence]
+            )
+
+    def hash(self) -> bytes:
+        self.fill_header_hashes()
+        return self.header.hash()
+
+    def encode(self) -> bytes:
+        """Deterministic serialization for parts/storage."""
+        from cometbft_tpu.types import codec
+
+        return codec.encode_block(self)
+
+    def make_part_set(self, part_size: int = 65536) -> PartSet:
+        return PartSet.from_data(self.encode(), part_size)
+
+    def block_id(self, part_set: Optional[PartSet] = None) -> BlockID:
+        ps = part_set or self.make_part_set()
+        return BlockID(hash=self.hash(), part_set_header=ps.header)
+
+    def validate_basic(self) -> str | None:
+        err = self.header.validate_basic()
+        if err:
+            return err
+        err = self.last_commit.validate_basic()
+        if err:
+            return err
+        self.fill_header_hashes()
+        if self.header.last_commit_hash != self.last_commit.hash():
+            return "last commit hash mismatch"
+        if self.header.data_hash != self.data.hash():
+            return "data hash mismatch"
+        return None

@@ -1,0 +1,228 @@
+"""Deterministic protobuf-wire codec for core types.
+
+Encode/decode for Block, Header, Commit, Vote, etc. — used by part sets,
+stores and the WAL.  Field numbering follows the reference's proto schema
+(proto/cometbft/types/types.proto) so the wire shapes are comparable.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.types.basic import BlockID, PartSetHeader, Timestamp
+from cometbft_tpu.types.block import (
+    Block,
+    Commit,
+    ConsensusVersion,
+    Data,
+    Header,
+)
+from cometbft_tpu.types.vote import CommitSig, Proposal, Vote
+
+
+# -- timestamps -------------------------------------------------------------
+
+def decode_timestamp(body: bytes) -> Timestamp:
+    f = pe.fields_dict(body)
+    return Timestamp(
+        seconds=pe.to_int64(f.get(1, [0])[-1]), nanos=pe.to_int64(f.get(2, [0])[-1])
+    )
+
+
+# -- block id ---------------------------------------------------------------
+
+def decode_part_set_header(body: bytes) -> PartSetHeader:
+    f = pe.fields_dict(body)
+    return PartSetHeader(total=f.get(1, [0])[-1], hash=bytes(f.get(2, [b""])[-1]))
+
+
+def decode_block_id(body: bytes) -> BlockID:
+    f = pe.fields_dict(body)
+    psh = f.get(2)
+    return BlockID(
+        hash=bytes(f.get(1, [b""])[-1]),
+        part_set_header=decode_part_set_header(psh[-1]) if psh else PartSetHeader(),
+    )
+
+
+# -- header -----------------------------------------------------------------
+
+def encode_header(h: Header) -> bytes:
+    return b"".join(
+        [
+            pe.t_message(1, h.version.encode()),
+            pe.t_string(2, h.chain_id),
+            pe.t_varint(3, h.height),
+            pe.t_message(4, h.time.encode()),
+            pe.t_message(5, h.last_block_id.encode()),
+            pe.t_bytes(6, h.last_commit_hash),
+            pe.t_bytes(7, h.data_hash),
+            pe.t_bytes(8, h.validators_hash),
+            pe.t_bytes(9, h.next_validators_hash),
+            pe.t_bytes(10, h.consensus_hash),
+            pe.t_bytes(11, h.app_hash),
+            pe.t_bytes(12, h.last_results_hash),
+            pe.t_bytes(13, h.evidence_hash),
+            pe.t_bytes(14, h.proposer_address),
+        ]
+    )
+
+
+def decode_header(body: bytes) -> Header:
+    f = pe.fields_dict(body)
+    ver = ConsensusVersion(0, 0)
+    if 1 in f:
+        vf = pe.fields_dict(f[1][-1])
+        ver = ConsensusVersion(vf.get(1, [0])[-1], vf.get(2, [0])[-1])
+    return Header(
+        version=ver,
+        chain_id=bytes(f.get(2, [b""])[-1]).decode(),
+        height=pe.to_int64(f.get(3, [0])[-1]),
+        time=decode_timestamp(f[4][-1]) if 4 in f else Timestamp(),
+        last_block_id=decode_block_id(f[5][-1]) if 5 in f else BlockID(),
+        last_commit_hash=bytes(f.get(6, [b""])[-1]),
+        data_hash=bytes(f.get(7, [b""])[-1]),
+        validators_hash=bytes(f.get(8, [b""])[-1]),
+        next_validators_hash=bytes(f.get(9, [b""])[-1]),
+        consensus_hash=bytes(f.get(10, [b""])[-1]),
+        app_hash=bytes(f.get(11, [b""])[-1]),
+        last_results_hash=bytes(f.get(12, [b""])[-1]),
+        evidence_hash=bytes(f.get(13, [b""])[-1]),
+        proposer_address=bytes(f.get(14, [b""])[-1]),
+    )
+
+
+# -- commit -----------------------------------------------------------------
+
+def encode_commit_sig(cs: CommitSig) -> bytes:
+    return b"".join(
+        [
+            pe.t_varint(1, cs.block_id_flag),
+            pe.t_bytes(2, cs.validator_address),
+            pe.t_message(3, cs.timestamp.encode()),
+            pe.t_bytes(4, cs.signature),
+        ]
+    )
+
+
+def decode_commit_sig(body: bytes) -> CommitSig:
+    f = pe.fields_dict(body)
+    return CommitSig(
+        block_id_flag=f.get(1, [0])[-1],
+        validator_address=bytes(f.get(2, [b""])[-1]),
+        timestamp=decode_timestamp(f[3][-1]) if 3 in f else Timestamp(),
+        signature=bytes(f.get(4, [b""])[-1]),
+    )
+
+
+def encode_commit(c: Commit) -> bytes:
+    out = [
+        pe.t_varint(1, c.height),
+        pe.t_varint(2, c.round_),
+        pe.t_message(3, c.block_id.encode(), always=True),
+    ]
+    for cs in c.signatures:
+        out.append(pe.t_message(4, encode_commit_sig(cs), always=True))
+    return b"".join(out)
+
+
+def decode_commit(body: bytes) -> Commit:
+    f = pe.fields_dict(body)
+    return Commit(
+        height=pe.to_int64(f.get(1, [0])[-1]),
+        round_=f.get(2, [0])[-1],
+        block_id=decode_block_id(f[3][-1]) if 3 in f else BlockID(),
+        signatures=[decode_commit_sig(b) for b in f.get(4, [])],
+    )
+
+
+# -- data / block -----------------------------------------------------------
+
+def encode_data(d: Data) -> bytes:
+    return b"".join(pe.t_message(1, tx, always=True) for tx in d.txs)
+
+
+def decode_data(body: bytes) -> Data:
+    f = pe.fields_dict(body)
+    return Data(txs=[bytes(t) for t in f.get(1, [])])
+
+
+def encode_block(b: Block) -> bytes:
+    b.fill_header_hashes()
+    return b"".join(
+        [
+            pe.t_message(1, encode_header(b.header), always=True),
+            pe.t_message(2, encode_data(b.data), always=True),
+            pe.t_message(3, b"", always=True),  # evidence list (placeholder)
+            pe.t_message(4, encode_commit(b.last_commit), always=True),
+        ]
+    )
+
+
+def decode_block(body: bytes) -> Block:
+    f = pe.fields_dict(body)
+    return Block(
+        header=decode_header(f[1][-1]),
+        data=decode_data(f[2][-1]) if 2 in f else Data(),
+        last_commit=decode_commit(f[4][-1]) if 4 in f else Commit(0, 0, BlockID(), []),
+        evidence=[],
+    )
+
+
+# -- vote / proposal --------------------------------------------------------
+
+def encode_vote(v: Vote) -> bytes:
+    return b"".join(
+        [
+            pe.t_varint(1, v.type_),
+            pe.t_varint(2, v.height),
+            pe.t_varint(3, v.round_),
+            pe.t_message(4, v.block_id.encode()),
+            pe.t_message(5, v.timestamp.encode()),
+            pe.t_bytes(6, v.validator_address),
+            pe.t_varint(7, v.validator_index + 1),  # +1: index 0 must survive
+            pe.t_bytes(8, v.signature),
+            pe.t_bytes(9, v.extension),
+            pe.t_bytes(10, v.extension_signature),
+        ]
+    )
+
+
+def decode_vote(body: bytes) -> Vote:
+    f = pe.fields_dict(body)
+    return Vote(
+        type_=f.get(1, [0])[-1],
+        height=pe.to_int64(f.get(2, [0])[-1]),
+        round_=f.get(3, [0])[-1],
+        block_id=decode_block_id(f[4][-1]) if 4 in f else BlockID(),
+        timestamp=decode_timestamp(f[5][-1]) if 5 in f else Timestamp(),
+        validator_address=bytes(f.get(6, [b""])[-1]),
+        validator_index=f.get(7, [0])[-1] - 1,
+        signature=bytes(f.get(8, [b""])[-1]),
+        extension=bytes(f.get(9, [b""])[-1]),
+        extension_signature=bytes(f.get(10, [b""])[-1]),
+    )
+
+
+def encode_proposal(p: Proposal) -> bytes:
+    return b"".join(
+        [
+            pe.t_varint(1, p.height),
+            pe.t_varint(2, p.round_),
+            pe.t_varint(3, p.pol_round + 1),  # shift: -1 -> 0 omitted
+            pe.t_message(4, p.block_id.encode()),
+            pe.t_message(5, p.timestamp.encode()),
+            pe.t_bytes(6, p.signature),
+        ]
+    )
+
+
+def decode_proposal(body: bytes) -> Proposal:
+    f = pe.fields_dict(body)
+    return Proposal(
+        height=pe.to_int64(f.get(1, [0])[-1]),
+        round_=f.get(2, [0])[-1],
+        pol_round=f.get(3, [0])[-1] - 1,
+        block_id=decode_block_id(f[4][-1]) if 4 in f else BlockID(),
+        timestamp=decode_timestamp(f[5][-1]) if 5 in f else Timestamp(),
+        signature=bytes(f.get(6, [b""])[-1]),
+    )

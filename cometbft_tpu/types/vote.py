@@ -1,0 +1,148 @@
+"""Vote and Proposal types (reference: types/vote.go, types/proposal.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from cometbft_tpu.types.basic import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    BlockID,
+    Timestamp,
+)
+from cometbft_tpu.types.canonical import (
+    canonical_proposal_sign_bytes,
+    canonical_vote_extension_sign_bytes,
+    canonical_vote_sign_bytes,
+)
+
+
+@dataclass
+class Vote:
+    type_: int
+    height: int
+    round_: int
+    block_id: BlockID  # zero block id == vote for nil
+    timestamp: Timestamp
+    validator_address: bytes
+    validator_index: int
+    signature: bytes = b""
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_zero()
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_vote_sign_bytes(
+            chain_id,
+            self.type_,
+            self.height,
+            self.round_,
+            None if self.block_id.is_zero() else self.block_id,
+            self.timestamp,
+        )
+
+    def extension_sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_vote_extension_sign_bytes(
+            chain_id, self.height, self.round_, self.extension
+        )
+
+    def validate_basic(self) -> str | None:
+        if self.type_ not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+            return "invalid vote type"
+        if self.height < 0:
+            return "negative height"
+        if self.round_ < 0:
+            return "negative round"
+        if len(self.validator_address) != 20:
+            return "invalid validator address"
+        if self.validator_index < 0:
+            return "negative validator index"
+        if not self.signature:
+            return "missing signature"
+        if len(self.signature) > 96:
+            return "signature too large"
+        if self.type_ == PREVOTE_TYPE and (
+            self.extension or self.extension_signature
+        ):
+            return "prevote cannot carry vote extension"
+        return None
+
+    def verify(self, chain_id: str, pub_key) -> bool:
+        """Reference: types/vote.go:227 — single-signature path."""
+        return pub_key.verify_signature(self.sign_bytes(chain_id), self.signature)
+
+    def copy(self) -> "Vote":
+        return replace(self)
+
+
+@dataclass
+class CommitSig:
+    """One commit signature (reference: types/block.go CommitSig)."""
+
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp: Timestamp = field(default_factory=Timestamp)
+    signature: bytes = b""
+
+    def absent(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_ABSENT
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    @staticmethod
+    def absent_sig() -> "CommitSig":
+        return CommitSig(BLOCK_ID_FLAG_ABSENT)
+
+    @staticmethod
+    def from_vote(vote: Vote) -> "CommitSig":
+        flag = BLOCK_ID_FLAG_NIL if vote.is_nil() else BLOCK_ID_FLAG_COMMIT
+        return CommitSig(
+            block_id_flag=flag,
+            validator_address=vote.validator_address,
+            timestamp=vote.timestamp,
+            signature=vote.signature,
+        )
+
+
+@dataclass
+class Proposal:
+    height: int
+    round_: int
+    pol_round: int  # -1 when no proof-of-lock
+    block_id: BlockID
+    timestamp: Timestamp
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_proposal_sign_bytes(
+            chain_id,
+            self.height,
+            self.round_,
+            self.pol_round,
+            None if self.block_id.is_zero() else self.block_id,
+            self.timestamp,
+        )
+
+    def validate_basic(self) -> str | None:
+        if self.height < 0:
+            return "negative height"
+        if self.round_ < 0:
+            return "negative round"
+        if self.pol_round < -1 or self.pol_round >= self.round_:
+            return "invalid pol_round"
+        if not self.block_id.is_complete():
+            return "proposal block id must be complete"
+        if not self.signature:
+            return "missing signature"
+        return None

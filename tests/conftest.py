@@ -1,17 +1,28 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; all sharding/mesh tests run
-against ``--xla_force_host_platform_device_count=8`` CPU devices.  This must
-run before the first ``import jax`` anywhere in the test session.
+against ``--xla_force_host_platform_device_count=8`` CPU devices.
+
+NOTE: this environment's axon sitecustomize force-updates
+``jax_platforms="axon,cpu"`` at interpreter start, overriding the
+JAX_PLATFORMS env var — so we must override back at the config level, after
+importing jax but before any backend is initialized.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Persistent compilation cache: the verify kernel takes minutes to compile;
+# cache it across test processes.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,152 @@
+"""Differential tests: JAX point ops + batched verifier vs the python oracle,
+including ZIP-215 edge cases (non-canonical encodings, small-order points,
+non-canonical s)."""
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import fe25519 as fe
+from cometbft_tpu.ops import ed25519_point as ep
+from cometbft_tpu.ops import verify as vf
+
+P = fe.P_INT
+
+
+def _pts_to_batch(pts):
+    """List of oracle extended points -> PointBatch."""
+    cols = {k: [] for k in "xyzt"}
+    for X, Y, Z, T in pts:
+        cols["x"].append(fe.limbs_of_int(X))
+        cols["y"].append(fe.limbs_of_int(Y))
+        cols["z"].append(fe.limbs_of_int(Z))
+        cols["t"].append(fe.limbs_of_int(T))
+    return ep.PointBatch(
+        *(jnp.asarray(np.stack(cols[k], axis=1)) for k in "xyzt")
+    )
+
+
+def _batch_to_affine(pb):
+    xs = np.asarray(fe.freeze(pb.x))
+    ys = np.asarray(fe.freeze(pb.y))
+    zs = np.asarray(fe.freeze(pb.z))
+    out = []
+    for i in range(xs.shape[1]):
+        X = fe.int_of_limbs(xs[:, i])
+        Y = fe.int_of_limbs(ys[:, i])
+        Z = fe.int_of_limbs(zs[:, i])
+        zi = pow(Z, P - 2, P)
+        out.append((X * zi % P, Y * zi % P))
+    return out
+
+
+def _affine(pt):
+    X, Y, Z, _ = pt
+    zi = pow(Z, P - 2, P)
+    return (X * zi % P, Y * zi % P)
+
+
+def test_point_add_double_match_oracle():
+    ks = [1, 2, 3, 7, 1000, ref.L - 2, 8]
+    pts = [ref.pt_mul(k, ref.BASE) for k in ks]
+    pb = _pts_to_batch(pts)
+    got_dbl = _batch_to_affine(ep.double(pb))
+    expect_dbl = [_affine(ref.pt_double(p)) for p in pts]
+    assert got_dbl == expect_dbl
+
+    qb = _pts_to_batch(list(reversed(pts)))
+    got_add = _batch_to_affine(ep.add(pb, qb))
+    expect_add = [
+        _affine(ref.pt_add(p, q)) for p, q in zip(pts, reversed(pts))
+    ]
+    assert got_add == expect_add
+
+
+def test_add_identity_and_small_order():
+    # complete formulas: adding identity and doubling small-order points works
+    ident = ref.IDENTITY
+    small = ref.pt_decompress_zip215((ref.P + 1).to_bytes(32, "little"))  # y=1 -> identity
+    two_tor = ref.pt_decompress_zip215((ref.P - 1).to_bytes(32, "little"))  # y=-1: 2-torsion
+    pts = [ident, small, two_tor, ref.BASE]
+    pb = _pts_to_batch(pts)
+    got = _batch_to_affine(ep.add(pb, pb))
+    expect = [_affine(ref.pt_double(p)) for p in pts]
+    assert got == expect
+
+
+def test_decompress_matches_oracle():
+    encs = []
+    for k in [1, 2, 3, 99, 12345]:
+        encs.append(ref.pt_compress(ref.pt_mul(k, ref.BASE)))
+    encs.append((ref.P + 1).to_bytes(32, "little"))  # non-canonical y
+    encs.append((2).to_bytes(32, "little"))  # non-point (non-square)
+    encs.append(bytes(32))  # y = 0
+    arr = np.stack([np.frombuffer(e, np.uint8) for e in encs])
+    sign = (arr[:, 31] >> 7).astype(np.int32)
+    masked = arr.copy()
+    masked[:, 31] &= 0x7F
+    ok, pb = ep.decompress(jnp.asarray(fe.bytes_to_limbs(masked)), jnp.asarray(sign))
+    ok = np.asarray(ok)
+    affs = _batch_to_affine(pb)
+    for i, e in enumerate(encs):
+        expect = ref.pt_decompress_zip215(e)
+        assert bool(ok[i]) == (expect is not None), f"enc {i}"
+        if expect is not None:
+            assert affs[i] == _affine(expect), f"enc {i}"
+
+
+def _sign_batch(n, tamper=None):
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = hashlib.sha256(b"batch%d" % i).digest()
+        pub = ref.pubkey_from_seed(seed)
+        msg = b"vote %d" % i
+        sig = ref.sign(seed, msg)
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(sig)
+    if tamper:
+        tamper(pubs, msgs, sigs)
+    return pubs, msgs, sigs
+
+
+def test_verify_batch_valid():
+    pubs, msgs, sigs = _sign_batch(12)
+    out = vf.verify_batch(pubs, msgs, sigs)
+    assert out.all()
+
+
+def test_verify_batch_mixed_and_edges():
+    pubs, msgs, sigs = _sign_batch(10)
+    # 0: corrupt sig R
+    sigs[0] = bytes([sigs[0][0] ^ 1]) + sigs[0][1:]
+    # 1: corrupt message
+    msgs[1] = msgs[1] + b"!"
+    # 2: non-canonical s (s + L)
+    s = int.from_bytes(sigs[2][32:], "little")
+    sigs[2] = sigs[2][:32] + (s + ref.L).to_bytes(32, "little")
+    # 3: wrong pubkey for message
+    pubs[3] = pubs[4]
+    # 5: small-order identity pubkey + zero sig (ZIP-215: valid)
+    ident = ref.pt_compress(ref.IDENTITY)
+    pubs[5], sigs[5] = ident, ident + bytes(32)
+    # 6: non-canonical y encoding of identity as pubkey (ZIP-215: valid)
+    nc = (ref.P + 1).to_bytes(32, "little")
+    pubs[6], sigs[6] = nc, nc + bytes(32)
+    # 7: non-point pubkey (y=2 non-square)
+    pubs[7] = (2).to_bytes(32, "little")
+    # 8: wrong-length signature (structural)
+    sigs[8] = sigs[8][:63]
+
+    got = vf.verify_batch(pubs, msgs, sigs)
+    expect = np.array(
+        [
+            ref.verify_zip215(p, m, s) if len(s) == 64 and len(p) == 32 else False
+            for p, m, s in zip(pubs, msgs, sigs)
+        ]
+    )
+    assert (got == expect).all()
+    # sanity on the expectation itself
+    assert list(expect) == [False, False, False, False, True, True, True, False, False, True]

