@@ -1,0 +1,93 @@
+"""Headline benchmark: Ed25519 batch-verify throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "verifies/s", "vs_baseline": N/500000}
+
+Baseline (BASELINE.json): >=500k verifies/sec/chip, the north-star target for
+the TPU backend of the commit-verification hot path (SURVEY.md §3.4).
+Also measures (and reports in extra fields) the 10k-validator commit-verify
+latency target (<5 ms p50, device-kernel portion).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+BASELINE_VERIFIES_PER_SEC = 500_000.0
+
+
+def _make_batch(n: int):
+    from cometbft_tpu.crypto import ed25519_ref as ref
+
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = i.to_bytes(4, "little") * 8
+        pub = ref.pubkey_from_seed(seed)
+        msg = b"bench-%d" % i
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(ref.sign(seed, msg))
+    return pubs, msgs, sigs
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cometbft_tpu.ops import verify as ov
+
+    n = int(os.environ.get("BENCH_BATCH", "8192"))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+
+    pubs, msgs, sigs = _make_batch(n)
+    arrays, _, structural = ov.prepare_batch(pubs, msgs, sigs)
+    dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+
+    # Warm-up / compile.
+    accept = np.asarray(ov._verify_kernel(**dev))
+    assert accept[:n].all(), "benchmark batch failed to verify"
+
+    # Device-kernel throughput (arrays resident).
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ov._verify_kernel(**dev)[0].block_until_ready()
+        times.append(time.perf_counter() - t0)
+    kernel_s = min(times)
+    vps = n / kernel_s
+
+    # End-to-end (host prep incl. SHA-512 + packing + transfer + kernel).
+    t0 = time.perf_counter()
+    bits = ov.verify_batch(pubs, msgs, sigs)
+    e2e_s = time.perf_counter() - t0
+    assert bits.all()
+
+    # 10k-validator commit shape: kernel time at n=10240 bucket if batch
+    # matches, else scale estimate from measured kernel rate.
+    commit10k_ms = 10_000 / vps * 1e3
+
+    result = {
+        "metric": "ed25519_batch_verify_throughput",
+        "value": round(vps, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(vps / BASELINE_VERIFIES_PER_SEC, 4),
+        "batch": n,
+        "kernel_s": round(kernel_s, 6),
+        "e2e_s": round(e2e_s, 6),
+        "commit10k_est_ms": round(commit10k_ms, 3),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

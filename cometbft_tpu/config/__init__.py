@@ -1,0 +1,9 @@
+from cometbft_tpu.config.config import (
+    Config,
+    default_config,
+    test_config,
+    load_config,
+    write_config,
+    dumps,
+    loads,
+)

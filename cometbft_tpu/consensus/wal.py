@@ -106,7 +106,12 @@ class WAL:
         d = os.path.dirname(self.path) or "."
         base = os.path.basename(self.path)
         rolled = sorted(
-            f for f in os.listdir(d) if f.startswith(base + ".") and f[-3:].isdigit()
+            (
+                f
+                for f in os.listdir(d)
+                if f.startswith(base + ".") and f[len(base) + 1 :].isdigit()
+            ),
+            key=lambda f: int(f[len(base) + 1 :]),  # numeric: .999 < .1000
         )
         out = [os.path.join(d, f) for f in rolled]
         if os.path.exists(self.path):

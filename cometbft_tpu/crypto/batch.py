@@ -35,7 +35,7 @@ def default_backend() -> str:
     via config (config.crypto.backend) or COMETBFT_TPU_CRYPTO_BACKEND."""
     global _DEFAULT_BACKEND
     env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
-    if env:
+    if env and env != "auto":
         return env
     with _LOCK:
         if _DEFAULT_BACKEND is None:
@@ -115,7 +115,8 @@ def create_batch_verifier(pub_key, backend: Optional[str] = None) -> BatchVerifi
     """Reference: crypto/batch/batch.go:10."""
     if not supports_batch_verifier(pub_key):
         raise ValueError(f"key type does not support batch verification: {pub_key}")
-    backend = backend or default_backend()
+    if backend is None or backend == "auto":
+        backend = default_backend()
     if backend == "tpu":
         return TpuBatchVerifier()
     if backend == "cpu":

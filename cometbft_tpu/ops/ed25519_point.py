@@ -120,7 +120,10 @@ def double_base_scalar_mul(
         p = add(p, select4(bs + 2 * bm, tbl))
         return p, None
 
-    p0 = identity(batch)
+    # Tie the initial carry's sharding variance to the (varying) input point
+    # so scan carry types match under shard_map.
+    zero = a.x - a.x
+    p0 = PointBatch(*(c + zero for c in identity(batch)))
     p, _ = lax.scan(body, p0, (bits_s, bits_m))
     return p
 

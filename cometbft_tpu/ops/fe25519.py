@@ -99,20 +99,27 @@ def limbs_to_bytes(x: np.ndarray) -> np.ndarray:
 # Device ops.  All take/return (20, B) int32 with limbs in [0, 2^13).
 # ---------------------------------------------------------------------------
 
+def _carry_chain(x: jnp.ndarray):
+    """One pass of sequential carry propagation over the leading axis
+    (lax.scan keeps the HLO graph O(1) in the limb count — unrolled chains
+    made the full verify kernel take minutes to compile).  Returns
+    (final_carry, rows) with every row in [0, 2^13)."""
+
+    def step(carry, row):
+        row = row + carry
+        c = row >> BITS  # arithmetic shift: floor semantics
+        return c, row - (c << BITS)
+
+    return lax.scan(step, jnp.zeros_like(x[0]), x)
+
+
 def _carry(x: jnp.ndarray) -> jnp.ndarray:
     """Signed carry propagation + top fold over a (20, B) array whose limbs
     may exceed 13 bits (|limb| < 2^30).  Two passes guarantee convergence for
     the bounds produced by add/sub/mul."""
     for _ in range(2):
-        rows = [x[i] for i in range(NLIMBS)]
-        carry = None
-        for i in range(NLIMBS):
-            if carry is not None:
-                rows[i] = rows[i] + carry
-            carry = rows[i] >> BITS  # arithmetic shift: floor semantics
-            rows[i] = rows[i] - (carry << BITS)
-        rows[0] = rows[0] + FOLD * carry  # 2^260 ≡ 608 (mod p)
-        x = jnp.stack(rows)
+        carry, rows = _carry_chain(x)
+        x = rows.at[0].add(FOLD * carry)  # 2^260 ≡ 608 (mod p)
     return x
 
 
@@ -130,29 +137,36 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
     return _carry(pad - a)
 
 
+# Column-sum matrix: _COLSUM[k, i*20+j] = 1 iff i+j == k.  Expressing the
+# 20x20 schoolbook column reduction as ONE (39,400)x(400,B) matmul keeps the
+# HLO graph tiny (the unrolled form is ~900 ops per multiply, which made the
+# full verify kernel take minutes to compile) and hands the reduction to the
+# MXU/VPU as a single fused contraction.
+_COLSUM = np.zeros((2 * NLIMBS - 1, NLIMBS * NLIMBS), np.float32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _COLSUM[_i + _j, _i * NLIMBS + _j] = 1.0
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 20x20 -> 39 columns, fold, carry."""
-    B = jnp.broadcast_shapes(a.shape, b.shape)[1]
-    ncols = 2 * NLIMBS - 1  # 39 product columns
-    cols = [jnp.zeros((B,), jnp.int32) for _ in range(ncols)]
-    for i in range(NLIMBS):
-        prod = a[i][None, :] * b  # (20, B); each term < 2^26
-        for j in range(NLIMBS):
-            cols[i + j] = cols[i + j] + prod[j]
+    """Schoolbook 20x20 -> 39 columns (one matmul), fold, carry."""
+    a = jnp.broadcast_to(a, jnp.broadcast_shapes(a.shape, b.shape))
+    b = jnp.broadcast_to(b, a.shape)
+    B = a.shape[1]
+    outer = (a[:, None, :] * b[None, :, :]).reshape(NLIMBS * NLIMBS, B)
+    colsum = jnp.asarray(_COLSUM.astype(np.int32))
+    cols_arr = jax.lax.dot_general(
+        colsum,
+        outer,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (39, B); each column < 20 * 2^26 < 2^31
     # Carry-propagate the 39 columns; the final carry is the (unmasked) value
-    # of virtual column 39 (< 2^14), folded below.
-    carry = None
-    for i in range(ncols):
-        if carry is not None:
-            cols[i] = cols[i] + carry
-        carry = cols[i] >> BITS
-        cols[i] = cols[i] - (carry << BITS)
-    # Fold columns 20..39 down with 2^260 ≡ 608.
-    rows = []
-    for i in range(NLIMBS):
-        hi = cols[i + NLIMBS] if i + NLIMBS < ncols else carry
-        rows.append(cols[i] + FOLD * hi)
-    return _carry(jnp.stack(rows))
+    # of virtual column 39 (< 2^14).  Fold columns 20..39 down with
+    # 2^260 ≡ 608.
+    carry, cols = _carry_chain(cols_arr)
+    hi = jnp.concatenate([cols[NLIMBS:], carry[None]], axis=0)  # (20, B)
+    return _carry(cols[:NLIMBS] + FOLD * hi)
 
 
 def square(a: jnp.ndarray) -> jnp.ndarray:
@@ -163,27 +177,16 @@ def freeze(x: jnp.ndarray) -> jnp.ndarray:
     """Canonical representative in [0, p): fold bits >= 255, then one
     conditional subtract of p."""
     x = _carry(x)
-    hi = x[NLIMBS - 1] >> (255 - BITS * (NLIMBS - 1))  # bits 255..259 of value
-    rows = [x[i] for i in range(NLIMBS)]
-    rows[NLIMBS - 1] = rows[NLIMBS - 1] - (hi << (255 - BITS * (NLIMBS - 1)))
-    rows[0] = rows[0] + TOP_FOLD * hi
-    carry = None
-    for i in range(NLIMBS):
-        if carry is not None:
-            rows[i] = rows[i] + carry
-        carry = rows[i] >> BITS
-        rows[i] = rows[i] - (carry << BITS)
+    topshift = 255 - BITS * (NLIMBS - 1)
+    hi = x[NLIMBS - 1] >> topshift  # bits 255..259 of value
+    x = x.at[NLIMBS - 1].add(-(hi << topshift))
+    x = x.at[0].add(TOP_FOLD * hi)
+    _, rows = _carry_chain(x)
     # value now < 2^255 + small => at most one subtract of p needed.
     p = jnp.asarray(_P_LIMBS[:, None], jnp.int32)
-    y = [rows[i] - p[i] for i in range(NLIMBS)]
-    borrow = None
-    for i in range(NLIMBS):
-        if borrow is not None:
-            y[i] = y[i] + borrow
-        borrow = y[i] >> BITS
-        y[i] = y[i] - (borrow << BITS)
+    borrow, y = _carry_chain(rows - p)
     take_y = borrow == 0  # x >= p
-    return jnp.stack([jnp.where(take_y, y[i], rows[i]) for i in range(NLIMBS)])
+    return jnp.where(take_y[None, :], y, rows)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -212,7 +215,9 @@ def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
     bits = jnp.asarray(
         [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], jnp.int32
     )
-    one = jnp.broadcast_to(const(1), x.shape)
+    # `+ (x - x)` ties the initial carry's sharding variance to x so the scan
+    # carry types match under shard_map (constants are unvarying by default).
+    one = jnp.broadcast_to(const(1), x.shape) + (x - x)
 
     def body(acc, bit):
         acc = square(acc)

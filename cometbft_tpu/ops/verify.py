@@ -44,8 +44,9 @@ def bucket_size(n: int) -> int:
     return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
 
 
-@partial(jax.jit, static_argnames=())
-def _verify_kernel(ay, asign, ry, rsign, bits_s, bits_m, s_ok):
+def verify_core(ay, asign, ry, rsign, bits_s, bits_m, s_ok):
+    """Unjitted kernel body — also the per-shard body for the mesh-sharded
+    path (cometbft_tpu.parallel.mesh)."""
     ok_a, a = ep.decompress(ay, asign)
     ok_r, r = ep.decompress(ry, rsign)
     p = ep.double_base_scalar_mul(bits_s, bits_m, a)
@@ -53,6 +54,9 @@ def _verify_kernel(ay, asign, ry, rsign, bits_s, bits_m, s_ok):
     # Cofactored equation: [8](s*B - h*A - R) == identity (ZIP-215).
     q = ep.double(ep.double(ep.double(q)))
     return ok_a & ok_r & s_ok & ep.is_identity(q)
+
+
+_verify_kernel = jax.jit(verify_core)
 
 
 def _scalars_to_bits(scalars: np.ndarray) -> np.ndarray:

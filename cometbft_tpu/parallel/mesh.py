@@ -1,0 +1,110 @@
+"""Multi-chip sharding of the Ed25519 batch-verify kernel.
+
+The reference's scale axis is validator-set size: a 10k-validator commit is
+one batch of 10k independent signature checks (SURVEY.md §2.2 — the
+"data-parallel crypto batching" axis; types/validation.go:220-324).  On TPU
+that maps to sharding the signature batch across a 1-D device mesh: each chip
+ladders its shard, the per-signature accept bits stay sharded (failure
+attribution is local), and a single ``psum`` over the mesh produces the
+global verdict — the only cross-chip traffic is one scalar per shard, riding
+ICI.
+
+This is the TPU-native analog of the reference spreading commit verification
+across CPU cores; there the batch is a single random-linear-combination MSM
+(curve25519-voi), here it is N independent lanes, so sharding is embarrassing
+and the collective cost is O(1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from cometbft_tpu.ops import fe25519 as fe
+from cometbft_tpu.ops import verify as ov
+
+SIG_AXIS = "sig"
+ARG_ORDER = ("ay", "asign", "ry", "rsign", "bits_s", "bits_m", "s_ok")
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices; axis name ``sig``."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.array(devices), (SIG_AXIS,))
+
+
+def _verify_shard(ay, asign, ry, rsign, bits_s, bits_m, s_ok):
+    """Per-device body: verify the local shard, contribute to the global
+    accept count via one psum (the only collective)."""
+    accept = ov.verify_core(ay, asign, ry, rsign, bits_s, bits_m, s_ok)
+    n_ok = jax.lax.psum(jnp.sum(accept.astype(jnp.int32)), SIG_AXIS)
+    return accept, n_ok
+
+
+_FN_CACHE: dict = {}
+
+
+def sharded_verify_fn(mesh: Mesh):
+    """jit-compiled mesh-sharded verifier.  Inputs are the packed batch arrays
+    from ``ops.verify.prepare_batch`` padded to a multiple of the mesh size;
+    limb arrays are (20, B) / bit arrays (253, B) sharded on the batch (lane)
+    axis, scalars (B,) sharded likewise."""
+    key = tuple(d.id for d in mesh.devices.flat)
+    if key in _FN_CACHE:
+        return _FN_CACHE[key]
+    batch_last = NamedSharding(mesh, P(None, SIG_AXIS))
+    vec = NamedSharding(mesh, P(SIG_AXIS))
+    fn = shard_map(
+        _verify_shard,
+        mesh=mesh,
+        in_specs=(
+            P(None, SIG_AXIS),  # ay
+            P(SIG_AXIS),        # asign
+            P(None, SIG_AXIS),  # ry
+            P(SIG_AXIS),        # rsign
+            P(None, SIG_AXIS),  # bits_s
+            P(None, SIG_AXIS),  # bits_m
+            P(SIG_AXIS),        # s_ok
+        ),
+        out_specs=(P(SIG_AXIS), P()),
+    )
+    out = (jax.jit(fn), (batch_last, vec))
+    _FN_CACHE[key] = out
+    return out
+
+
+def pad_to_mesh(arrays: dict, mesh: Mesh) -> dict:
+    """Pad the batch axis up to a multiple of the mesh size."""
+    n_dev = mesh.devices.size
+    b = arrays["asign"].shape[0]
+    pad = (-b) % n_dev
+    if pad == 0:
+        return arrays
+    out = {}
+    for k, v in arrays.items():
+        if v.ndim == 1:
+            out[k] = np.concatenate([v, np.zeros((pad,), v.dtype)])
+        else:
+            out[k] = np.concatenate([v, np.zeros((v.shape[0], pad), v.dtype)], axis=1)
+    return out
+
+
+def verify_batch_sharded(
+    pubs: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    mesh: Optional[Mesh] = None,
+) -> np.ndarray:
+    """Mesh-sharded analogue of ``ops.verify.verify_batch``; returns (n,) bool."""
+    mesh = mesh or make_mesh()
+    arrays, n, structural = ov.prepare_batch(pubs, msgs, sigs)
+    arrays = pad_to_mesh(arrays, mesh)
+    fn, _ = sharded_verify_fn(mesh)
+    accept, _ = fn(*(jnp.asarray(arrays[k]) for k in ARG_ORDER))
+    return (np.asarray(accept)[: len(structural)] & structural)[:n]

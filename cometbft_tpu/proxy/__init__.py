@@ -1,0 +1,7 @@
+from cometbft_tpu.proxy.multi_app_conn import (
+    AppConns,
+    ClientCreator,
+    local_client_creator,
+    remote_client_creator,
+    new_multi_app_conn,
+)

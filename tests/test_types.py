@@ -232,14 +232,14 @@ def test_verify_commit_insufficient_power():
     # construct a commit with only 3/6 validators signing the block (the vote
     # set itself would refuse to make such a commit, so build it directly —
     # this is what a light client receiving a forged commit sees)
-    privs, vals, _ = _mk_validators(6)
+    privs, vals, by_addr = _mk_validators(6)
     bid = _block_id()
     sigs = []
-    for i, p in enumerate(privs):
-        if i >= 3:
+    for idx, val in enumerate(vals.validators):
+        if idx >= 3:
             sigs.append(CommitSig.absent_sig())
             continue
-        v = _sign_vote(p, vals, bid)
+        v = _sign_vote(by_addr[val.address], vals, bid)
         sigs.append(CommitSig.from_vote(v))
     commit = Commit(height=3, round_=0, block_id=bid, signatures=sigs)
     with pytest.raises(NotEnoughPowerError):
