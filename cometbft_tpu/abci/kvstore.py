@@ -79,7 +79,7 @@ class KVStoreApplication(BaseApplication):
 
     def query(self, req):
         if req.path == "/val":
-            key = req.data.decode()
+            key = req.data.decode(errors="replace")
             power = self.validators.get(key, 0)
             return at.QueryResponse(
                 code=at.CODE_TYPE_OK,

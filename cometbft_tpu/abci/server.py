@@ -52,8 +52,11 @@ class ABCIServer:
         self._threads.append(t)
 
     @property
-    def bound_port(self) -> int:
+    def bound_port(self) -> Optional[int]:
+        """TCP port actually bound, or None for unix-socket listeners."""
         assert self._listener is not None
+        if self._listener.family == socket.AF_UNIX:
+            return None
         return self._listener.getsockname()[1]
 
     def _accept_loop(self) -> None:
@@ -68,6 +71,7 @@ class ABCIServer:
                 target=self._handle_conn, args=(conn,), daemon=True
             )
             t.start()
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _handle_conn(self, conn: socket.socket) -> None:

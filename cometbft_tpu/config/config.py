@@ -372,7 +372,12 @@ def _toml_value(v) -> str:
     if isinstance(v, float):
         return repr(v)
     if isinstance(v, str):
-        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+        s = v.replace("\\", "\\\\").replace('"', '\\"')
+        s = "".join(
+            c if ord(c) >= 0x20 and c != "\x7f" else f"\\u{ord(c):04x}"
+            for c in s
+        )
+        return '"' + s + '"'
     if isinstance(v, list):
         return "[" + ", ".join(_toml_value(x) for x in v) + "]"
     raise TypeError(f"unsupported TOML value: {type(v)}")
@@ -419,7 +424,7 @@ def loads(text: str) -> Config:
 
 def write_config(cfg: Config, path: Optional[str] = None) -> None:
     path = path or _home(cfg.base.home, "config", "config.toml")
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as f:
         f.write(dumps(cfg))
 

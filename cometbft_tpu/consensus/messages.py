@@ -1,0 +1,196 @@
+"""Consensus messages + WAL serialization.
+
+Reference: internal/consensus/msgs.go (p2p + WAL payloads).  The state
+machine consumes three data messages (Proposal, BlockPart, Vote); the reactor
+adds round-state gossip messages (NewRoundStep, NewValidBlock, HasVote,
+VoteSetMaj23, VoteSetBits, ProposalPOL).  WAL records are tagged frames:
+1-byte kind + payload in the same deterministic proto encoding used on the
+wire, so crash replay feeds the identical bytes back through the handler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.basic import BlockID
+from cometbft_tpu.types.part_set import Part
+from cometbft_tpu.types.vote import Proposal, Vote
+
+# message kinds (WAL + wire tags)
+MSG_PROPOSAL = 1
+MSG_BLOCK_PART = 2
+MSG_VOTE = 3
+MSG_TIMEOUT = 4  # WAL-only: timeout that was processed
+MSG_EVENT_ROUND_STEP = 5  # WAL-only: state-transition marker for replay
+
+MSG_NEW_ROUND_STEP = 16
+MSG_NEW_VALID_BLOCK = 17
+MSG_PROPOSAL_POL = 18
+MSG_HAS_VOTE = 19
+MSG_VOTE_SET_MAJ23 = 20
+MSG_VOTE_SET_BITS = 21
+MSG_HAS_PROPOSAL_BLOCK_PART = 22
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round_: int
+    part: Part
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+
+@dataclass
+class NewRoundStepMessage:
+    height: int
+    round_: int
+    step: int
+    seconds_since_start_time: int = 0
+    last_commit_round: int = -1
+
+
+@dataclass
+class NewValidBlockMessage:
+    height: int
+    round_: int
+    block_part_set_header: object = None  # PartSetHeader
+    blockparts: list[bool] = field(default_factory=list)
+    is_commit: bool = False
+
+
+@dataclass
+class HasVoteMessage:
+    height: int
+    round_: int
+    type_: int
+    index: int
+
+
+@dataclass
+class VoteSetMaj23Message:
+    height: int
+    round_: int
+    type_: int
+    block_id: BlockID = field(default_factory=BlockID)
+
+
+@dataclass
+class VoteSetBitsMessage:
+    height: int
+    round_: int
+    type_: int
+    block_id: BlockID = field(default_factory=BlockID)
+    votes: list[bool] = field(default_factory=list)
+
+
+@dataclass
+class ProposalPOLMessage:
+    height: int
+    proposal_pol_round: int
+    proposal_pol: list[bool] = field(default_factory=list)
+
+
+@dataclass
+class MsgInfo:
+    """A message + where it came from ("" = internal)."""
+
+    msg: object
+    peer_id: str = ""
+
+
+# -- serialization ----------------------------------------------------------
+
+def _encode_part(part: Part) -> bytes:
+    proof = part.proof
+    proof_enc = (
+        pe.t_varint(1, proof.total)
+        + pe.t_varint(2, proof.index)
+        + pe.t_bytes(3, proof.leaf_hash)
+    )
+    for aunt in proof.aunts:
+        proof_enc += pe.t_bytes(4, aunt)
+    return (
+        pe.t_varint(1, part.index)
+        + pe.t_bytes(2, part.bytes_)
+        + pe.t_message(3, proof_enc)
+    )
+
+
+def _decode_part(body: bytes) -> Part:
+    from cometbft_tpu.crypto.merkle import Proof
+
+    fields = pe.fields_dict(body)
+    pf = pe.fields_dict(fields.get(3, [b""])[0])
+    proof = Proof(
+        total=pf.get(1, [0])[0],
+        index=pf.get(2, [0])[0],
+        leaf_hash=pf.get(3, [b""])[0],
+        aunts=pf.get(4, []),
+    )
+    return Part(
+        index=fields.get(1, [0])[0], bytes_=fields.get(2, [b""])[0], proof=proof
+    )
+
+
+def encode_msg(msg: object) -> bytes:
+    """Tagged encoding for WAL + wire."""
+    if isinstance(msg, ProposalMessage):
+        return bytes([MSG_PROPOSAL]) + codec.encode_proposal(msg.proposal)
+    if isinstance(msg, BlockPartMessage):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.round_)
+            + pe.t_message(3, _encode_part(msg.part))
+        )
+        return bytes([MSG_BLOCK_PART]) + body
+    if isinstance(msg, VoteMessage):
+        return bytes([MSG_VOTE]) + codec.encode_vote(msg.vote)
+    raise TypeError(f"cannot encode {type(msg).__name__}")
+
+
+def decode_msg(raw: bytes) -> object:
+    kind, body = raw[0], raw[1:]
+    if kind == MSG_PROPOSAL:
+        return ProposalMessage(codec.decode_proposal(body))
+    if kind == MSG_BLOCK_PART:
+        fields = pe.fields_dict(body)
+        return BlockPartMessage(
+            height=fields.get(1, [0])[0],
+            round_=fields.get(2, [0])[0],
+            part=_decode_part(fields.get(3, [b""])[0]),
+        )
+    if kind == MSG_VOTE:
+        return VoteMessage(codec.decode_vote(body))
+    raise ValueError(f"unknown message kind {kind}")
+
+
+def encode_timeout_wal(duration: float, height: int, round_: int, step: int) -> bytes:
+    body = (
+        pe.t_varint(1, int(duration * 1e9))
+        + pe.t_varint(2, height)
+        + pe.t_varint(3, round_)
+        + pe.t_varint(4, step)
+    )
+    return bytes([MSG_TIMEOUT]) + body
+
+
+def decode_timeout_wal(raw: bytes):
+    fields = pe.fields_dict(raw[1:])
+    return (
+        fields.get(1, [0])[0] / 1e9,
+        fields.get(2, [0])[0],
+        fields.get(3, [0])[0],
+        fields.get(4, [0])[0],
+    )

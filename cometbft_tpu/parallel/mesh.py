@@ -55,7 +55,7 @@ def sharded_verify_fn(mesh: Mesh):
     from ``ops.verify.prepare_batch`` padded to a multiple of the mesh size;
     limb arrays are (20, B) / bit arrays (253, B) sharded on the batch (lane)
     axis, scalars (B,) sharded likewise."""
-    key = tuple(d.id for d in mesh.devices.flat)
+    key = tuple((d.platform, d.id) for d in mesh.devices.flat)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
     batch_last = NamedSharding(mesh, P(None, SIG_AXIS))

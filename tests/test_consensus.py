@@ -1,0 +1,99 @@
+"""Consensus state machine: in-process multi-validator networks.
+
+Model: reference internal/consensus/{state,reactor}_test.go fixtures — N
+in-memory nodes over a loopback net, kvstore app, real signing and real
+(TPU-backed where available) commit verification on every ApplyBlock.
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from tests.net_harness import (
+    LoopbackNet,
+    fast_consensus_config,
+    make_genesis,
+    make_network,
+    make_node,
+)
+
+
+@pytest.fixture
+def net4(tmp_path):
+    net = make_network(4, tmp_path)
+    yield net
+    net.stop()
+
+
+def test_four_validators_make_progress(net4):
+    net4.start()
+    net4.wait_for_height(3, timeout=60)
+    # all nodes agree on block hashes
+    for h in range(1, 3):
+        hashes = {
+            n.block_store.load_block_meta(h).block_id.hash for n in net4.nodes
+        }
+        assert len(hashes) == 1, f"fork at height {h}"
+
+
+def test_transactions_commit_and_apply(net4):
+    net4.start()
+    net4.wait_for_height(1, timeout=60)
+    # submit a tx to one node's mempool; gossip is out of scope here, so
+    # inject into every node (the p2p mempool reactor arrives later)
+    tx = b"name=satoshi"
+    for node in net4.nodes:
+        node.mempool.check_tx(tx)
+    net4.wait_for_height(net4.nodes[0].cs.height + 2, timeout=60)
+    # the tx must be applied on every node
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        res = [
+            n.app.query(at.QueryRequest(path="/store", data=b"name"))
+            for n in net4.nodes
+        ]
+        if all(r.value == b"satoshi" for r in res):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("tx not applied on all nodes")
+    # and removed from mempools
+    for node in net4.nodes:
+        assert node.mempool.size() == 0
+
+
+def test_single_validator_chain(tmp_path):
+    """One validator must make blocks alone (no quorum needed beyond self)."""
+    net = make_network(1, tmp_path)
+    try:
+        net.start()
+        net.wait_for_height(3, timeout=30)
+    finally:
+        net.stop()
+
+
+def test_progress_with_one_node_down(tmp_path):
+    """3 of 4 validators (>2/3 power) must still commit blocks."""
+    privs, gdoc = make_genesis(4)
+    nodes = [make_node(i, privs[i], gdoc, tmp_path) for i in range(3)]  # node3 absent
+    net = LoopbackNet(nodes)
+    try:
+        net.start()
+        net.wait_for_height(2, timeout=90)
+    finally:
+        net.stop()
+
+
+def test_wal_written_and_marked(net4):
+    net4.start()
+    net4.wait_for_height(2, timeout=60)
+    node = net4.nodes[0]
+    # WAL must contain the end-height marker for height 1
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if node.cs.wal is not None and node.cs.wal.search_for_end_height(1):
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("no #ENDHEIGHT 1 in WAL")
