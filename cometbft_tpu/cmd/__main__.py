@@ -1,0 +1,4 @@
+from cometbft_tpu.cmd.main import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,282 @@
+"""CLI entry point (reference: cmd/cometbft/main.go:15-35 + commands/).
+
+Commands: init, start, show-node-id, show-validator, gen-validator,
+unsafe-reset-all, version, testnet, rollback.  ``python -m cometbft_tpu.cmd
+<command> --home <dir>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+from cometbft_tpu.config import config as cfgmod
+from cometbft_tpu.version import __version__
+
+
+def _load_config(home: str):
+    path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(path):
+        cfg = cfgmod.load_config(home)
+    else:
+        cfg = cfgmod.default_config()
+    cfg.base.home = home
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """Reference: commands/init.go — write config, genesis, node key, privval."""
+    from cometbft_tpu.node.nodekey import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.basic import Timestamp
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    home = args.home
+    cfg = cfgmod.default_config()
+    cfg.base.home = home
+    cfgmod.write_config(cfg)
+
+    pv = FilePV.load_or_generate(
+        os.path.join(home, cfg.base.priv_validator_key_file),
+        os.path.join(home, cfg.base.priv_validator_state_file),
+    )
+    NodeKey.load_or_generate(os.path.join(home, cfg.base.node_key_file))
+
+    genesis_path = os.path.join(home, cfg.base.genesis_file)
+    if not os.path.exists(genesis_path):
+        chain_id = args.chain_id or f"test-chain-{int(time.time()) % 100000}"
+        gdoc = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time=Timestamp.now(),
+            validators=[GenesisValidator(pv.pub_key(), 10)],
+        )
+        os.makedirs(os.path.dirname(genesis_path), exist_ok=True)
+        with open(genesis_path, "w") as f:
+            f.write(gdoc.to_json())
+        print(f"Generated genesis file {genesis_path}")
+    print(f"Initialized node in {home}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """Reference: commands/run_node.go."""
+    from cometbft_tpu.node.node import Node
+
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    err = cfg.validate_basic()
+    if err:
+        print(f"invalid config: {err}", file=sys.stderr)
+        return 1
+    node = Node(cfg)
+    node.start()
+
+    stop = []
+
+    def on_signal(signum, frame):
+        stop.append(signum)
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from cometbft_tpu.node.nodekey import NodeKey
+
+    cfg = _load_config(args.home)
+    nk = NodeKey.load_or_generate(
+        os.path.join(args.home, cfg.base.node_key_file)
+    )
+    print(nk.node_id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    import base64
+
+    from cometbft_tpu.privval.file_pv import FilePV
+
+    cfg = _load_config(args.home)
+    pv = FilePV.load_or_generate(
+        os.path.join(args.home, cfg.base.priv_validator_key_file),
+        os.path.join(args.home, cfg.base.priv_validator_state_file),
+    )
+    print(
+        json.dumps(
+            {
+                "type": "tendermint/PubKeyEd25519",
+                "value": base64.b64encode(pv.pub_key().bytes()).decode(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    import base64
+
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+    priv = Ed25519PrivKey.generate()
+    print(
+        json.dumps(
+            {
+                "address": priv.pub_key().address().hex().upper(),
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": base64.b64encode(priv.pub_key().bytes()).decode(),
+                },
+                "priv_key": {
+                    "type": "tendermint/PrivKeyEd25519",
+                    "value": base64.b64encode(priv.bytes()).decode(),
+                },
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """Reference: commands/reset.go — wipe data dir, keep config + keys,
+    reset privval state."""
+    cfg = _load_config(args.home)
+    data_dir = os.path.join(args.home, cfg.base.db_dir)
+    if os.path.isdir(data_dir):
+        shutil.rmtree(data_dir)
+        os.makedirs(data_dir)
+        print(f"Removed all data in {data_dir}")
+    pv_state = os.path.join(args.home, cfg.base.priv_validator_state_file)
+    os.makedirs(os.path.dirname(pv_state), exist_ok=True)
+    with open(pv_state, "w") as f:
+        json.dump({"height": 0, "round": 0, "step": 0}, f)
+    print("Reset private validator state")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Reference: commands/testnet.go — generate N validator home dirs
+    sharing one genesis."""
+    from cometbft_tpu.node.nodekey import NodeKey
+    from cometbft_tpu.privval.file_pv import FilePV
+    from cometbft_tpu.types.basic import Timestamp
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    n = args.v
+    out = args.o
+    chain_id = args.chain_id or f"testnet-{int(time.time()) % 100000}"
+    pvs = []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = cfgmod.default_config()
+        cfg.base.home = home
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{26657 + 10 * i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{26656 + 10 * i}"
+        cfgmod.write_config(cfg)
+        pv = FilePV.load_or_generate(
+            os.path.join(home, cfg.base.priv_validator_key_file),
+            os.path.join(home, cfg.base.priv_validator_state_file),
+        )
+        NodeKey.load_or_generate(os.path.join(home, cfg.base.node_key_file))
+        pvs.append(pv)
+
+    gdoc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(pv.pub_key(), 10) for pv in pvs],
+    )
+    for i in range(n):
+        path = os.path.join(out, f"node{i}", "config", "genesis.json")
+        with open(path, "w") as f:
+            f.write(gdoc.to_json())
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """Reference: commands/rollback.go — roll state back one height."""
+    from cometbft_tpu.node.rollback import rollback_state
+
+    cfg = _load_config(args.home)
+    height, app_hash = rollback_state(cfg, remove_block=args.hard)
+    print(f"Rolled back state to height {height} and hash {app_hash.hex()}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cometbft_tpu", description="TPU-native BFT consensus node"
+    )
+    p.add_argument(
+        "--home",
+        default=os.environ.get("CMTHOME", os.path.expanduser("~/.cometbft_tpu")),
+        help="node home directory",
+    )
+    sub = p.add_subparsers(dest="command")
+
+    sp = sub.add_parser("init", help="initialize a node home directory")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("show-node-id", help="show the p2p node ID")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser("show-validator", help="show validator pubkey")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("gen-validator", help="generate a validator keypair")
+    sp.set_defaults(fn=cmd_gen_validator)
+
+    sp = sub.add_parser("unsafe-reset-all", help="wipe blockchain data")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("testnet", help="generate testnet home dirs")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--o", default="./mytestnet", help="output directory")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("rollback", help="roll back one block")
+    sp.add_argument("--hard", action="store_true", help="also remove the block")
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 1
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Node assembly: wires stores → ABCI proxy → handshake → mempool →
+consensus → RPC (reference: node/node.go:280-660, node/setup.go).
+
+Startup phases mirror the reference: load genesis/state, start the app
+proxy, ABCI handshake (InitChain / block replay), build mempool + block
+executor + consensus, then serve RPC.  The p2p switch slots in behind
+``broadcast_hook``/``add_peer_message`` once the transport layer is wired
+(reference ordering: node/node.go:584 OnStart).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config.config import Config
+from cometbft_tpu.consensus.replay import Handshaker
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.mempool.clist_mempool import CListMempool, NopMempool
+from cometbft_tpu.node.nodekey import NodeKey
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.proxy.multi_app_conn import (
+    AppConns,
+    local_client_creator,
+    remote_client_creator,
+)
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.state import State, state_from_genesis
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.block_store import BlockStore
+from cometbft_tpu.store.kv import open_kv
+from cometbft_tpu.types.events import EventBus
+from cometbft_tpu.types.genesis import GenesisDoc
+
+
+def _builtin_app(name: str):
+    """Registry of in-process apps (reference: abci/example + proxy
+    DefaultClientCreator's builtin path)."""
+    if name in ("kvstore", "persistent_kvstore"):
+        return KVStoreApplication()
+    if name == "noop":
+        from cometbft_tpu.abci.application import BaseApplication
+
+        return BaseApplication()
+    raise ValueError(f"unknown builtin app {name!r}")
+
+
+class Node(BaseService):
+    """Reference: node/node.go Node."""
+
+    def __init__(self, config: Config, logger: Optional[liblog.Logger] = None):
+        super().__init__("Node")
+        self.config = config
+        self.logger = logger or liblog.Logger(
+            level=liblog.parse_level(config.base.log_level)
+        )
+        home = config.base.home
+
+        # -- stores (reference: node/setup.go:161 initDBs) ------------------
+        data_dir = os.path.join(home, config.base.db_dir)
+        os.makedirs(data_dir, exist_ok=True)
+        self.db = open_kv(
+            config.base.db_backend, os.path.join(data_dir, "chain.db")
+        )
+        self.block_store = BlockStore(self.db)
+        self.state_store = StateStore(self.db)
+
+        # -- genesis + state ------------------------------------------------
+        genesis_path = os.path.join(home, config.base.genesis_file)
+        with open(genesis_path) as f:
+            self.genesis_doc = GenesisDoc.from_json(f.read())
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis(self.genesis_doc)
+
+        # -- node key + privval --------------------------------------------
+        self.node_key = NodeKey.load_or_generate(
+            os.path.join(home, config.base.node_key_file)
+        )
+        self.priv_validator = FilePV.load_or_generate(
+            os.path.join(home, config.base.priv_validator_key_file),
+            os.path.join(home, config.base.priv_validator_state_file),
+        )
+
+        # -- ABCI proxy (reference: node/node.go:359) -----------------------
+        if config.base.abci == "builtin":
+            self.app = _builtin_app(config.base.proxy_app)
+            creator = local_client_creator(self.app)
+        else:
+            self.app = None
+            creator = remote_client_creator(config.base.proxy_app)
+        self.proxy_app = AppConns(creator)
+        self.proxy_app.start()
+
+        # -- event bus ------------------------------------------------------
+        self.event_bus = EventBus()
+
+        # -- handshake (reference: node/node.go:411 doHandshake) ------------
+        handshaker = Handshaker(
+            self.state_store,
+            self.block_store,
+            self.genesis_doc,
+            event_bus=self.event_bus,
+            logger=self.logger.with_(module="handshaker"),
+        )
+        state = handshaker.handshake(state, self.proxy_app)
+        self.state = state
+
+        # -- mempool --------------------------------------------------------
+        info = self.proxy_app.query.info()
+        if config.mempool.type_ == "nop":
+            self.mempool = NopMempool()
+        else:
+            self.mempool = CListMempool(
+                config.mempool,
+                self.proxy_app.mempool,
+                height=state.last_block_height,
+                lane_priorities=dict(info.lane_priorities),
+                default_lane=info.default_lane,
+            )
+            if not config.consensus.create_empty_blocks:
+                self.mempool.enable_txs_available()
+
+        # -- block executor -------------------------------------------------
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.block_store,
+            self.proxy_app.consensus,
+            self.mempool,
+            event_bus=self.event_bus,
+            logger=self.logger.with_(module="state"),
+        )
+
+        # -- consensus ------------------------------------------------------
+        wal_path = os.path.join(home, config.consensus.wal_file)
+        self.consensus = ConsensusState(
+            config.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            self.mempool,
+            priv_validator=self.priv_validator,
+            wal=WAL(wal_path),
+            event_bus=self.event_bus,
+            logger=self.logger.with_(module="consensus"),
+        )
+
+        # -- RPC ------------------------------------------------------------
+        self.rpc_server = None
+        self._tx_waiter_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.config.rpc.laddr:
+            from cometbft_tpu.rpc.core import Environment
+            from cometbft_tpu.rpc.server import RPCServer
+
+            env = Environment(self)
+            self.rpc_server = RPCServer(self.config.rpc, env, self.event_bus)
+            self.rpc_server.start()
+        self.consensus.start()
+        if self.mempool.txs_available() is not None:
+            self._tx_waiter_thread = threading.Thread(
+                target=self._tx_waiter, daemon=True
+            )
+            self._tx_waiter_thread.start()
+        self.logger.info(
+            "node started",
+            node_id=self.node_key.node_id,
+            chain_id=self.genesis_doc.chain_id,
+            height=self.state.last_block_height,
+        )
+
+    def _tx_waiter(self) -> None:
+        """Forward mempool txs-available pulses into consensus (reference:
+        txNotifier channel, state.go:1026 handleTxsAvailable)."""
+        ev = self.mempool.txs_available()
+        while self.is_running:
+            if ev.wait(timeout=0.2):
+                ev.clear()
+                self.consensus.notify_txs_available()
+
+    def on_stop(self) -> None:
+        self.consensus.stop()
+        if self.rpc_server is not None:
+            self.rpc_server.stop()
+        self.proxy_app.stop()
+        self.db.close()
+        self.logger.info("node stopped")
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def current_height(self) -> int:
+        return self.block_store.height()

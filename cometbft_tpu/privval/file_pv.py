@@ -70,6 +70,7 @@ class DoubleSignError(Exception):
 
 def _atomic_write(path: str, data: bytes) -> None:
     d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d)
     try:
         with os.fdopen(fd, "wb") as f:

@@ -45,10 +45,17 @@ _K_STATE = b"blockStore"
 
 @dataclass
 class BlockMeta:
+    """Reference: types/block_meta.go — carries the full header so RPC
+    routes (blockchain, header, status) need not load block parts."""
+
     block_id: BlockID
     block_size: int
     num_txs: int
-    header_height: int
+    header: "Header"
+
+    @property
+    def header_height(self) -> int:
+        return self.header.height
 
     def encode(self) -> bytes:
         return b"".join(
@@ -56,7 +63,7 @@ class BlockMeta:
                 pe.t_message(1, self.block_id.encode(), always=True),
                 pe.t_varint(2, self.block_size),
                 pe.t_varint(3, self.num_txs),
-                pe.t_varint(4, self.header_height),
+                pe.t_message(4, codec.encode_header(self.header), always=True),
             ]
         )
 
@@ -67,7 +74,7 @@ class BlockMeta:
             block_id=codec.decode_block_id(f[1][-1]) if 1 in f else BlockID(),
             block_size=f.get(2, [0])[-1],
             num_txs=f.get(3, [0])[-1],
-            header_height=f.get(4, [0])[-1],
+            header=codec.decode_header(f[4][-1]),
         )
 
 
@@ -119,7 +126,7 @@ class BlockStore:
                 block_id=BlockID(hash=block.hash(), part_set_header=part_set.header),
                 block_size=part_set.byte_size,
                 num_txs=len(block.data.txs),
-                header_height=height,
+                header=block.header,
             )
             sets.append((_k_meta(height), meta.encode()))
             for i in range(part_set.header.total):
@@ -212,6 +219,25 @@ class BlockStore:
         return None
 
     # -- pruning ----------------------------------------------------------
+
+    def delete_latest_block(self) -> None:
+        """Remove the highest block (reference: store/store.go
+        DeleteLatestBlock, used by hard rollback)."""
+        with self._lock:
+            h = self._height
+            if h == 0:
+                return
+            # keep _k_commit(h-1): it certifies the block that REMAINS the
+            # head (reference: store/store.go DeleteLatestBlock deletes the
+            # commit key at the target height only)
+            deletes = [_k_meta(h), _k_commit(h), _k_seen_commit(h)]
+            meta = self.load_block_meta(h)
+            if meta:
+                for i in range(meta.block_id.part_set_header.total):
+                    deletes.append(_k_part(h, i))
+            self._db.write_batch([], deletes)
+            self._height = h - 1
+            self._save_range()
 
     def prune_blocks(self, retain_height: int) -> int:
         """Reference: store/store.go:474 PruneBlocks.  Returns pruned count."""

@@ -2,6 +2,7 @@
 
 # Semantic version of this framework.
 __version__ = "0.1.0"
+CMT_SEMVER = __version__
 
 # Protocol versions. Block/P2P protocol numbers track the reference so that
 # genesis docs and headers carry comparable version metadata.

@@ -1,0 +1,168 @@
+"""End-to-end slice: CLI init → node start → JSON-RPC → blocks commit.
+
+Reference model: node/node_test.go + rpc tests — a full single-validator
+node with the builtin kvstore app, driven over HTTP JSON-RPC including
+broadcast_tx_commit and WebSocket NewBlock subscriptions.
+"""
+
+import base64
+import json
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.cmd.main import main as cli_main
+from cometbft_tpu.config import config as cfgmod
+from cometbft_tpu.node.node import Node
+
+
+def _rpc(port: int, method: str, params=None):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params or {}}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=20) as resp:
+        doc = json.loads(resp.read())
+    if "error" in doc:
+        raise RuntimeError(doc["error"])
+    return doc["result"]
+
+
+@pytest.fixture
+def node(tmp_path):
+    home = str(tmp_path / "node")
+    assert cli_main(["--home", home, "init", "--chain-id", "rpc-test-chain"]) == 0
+    cfg = cfgmod.load_config(home)
+    cfg.base.home = home
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port
+    cfg.consensus.timeout_commit_ms = 50
+    cfg.consensus.timeout_propose_ms = 2000
+    n = Node(cfg)
+    n.start()
+    yield n
+    n.stop()
+
+
+def _wait_height(node, h, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if node.block_store.height() >= h:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"node at {node.block_store.height()}, wanted {h}")
+
+
+def test_cli_init_files(tmp_path):
+    home = str(tmp_path / "init-home")
+    assert cli_main(["--home", home, "init"]) == 0
+    for rel in (
+        "config/config.toml",
+        "config/genesis.json",
+        "config/node_key.json",
+        "config/priv_validator_key.json",
+    ):
+        assert (tmp_path / "init-home" / rel).exists(), rel
+
+
+def test_status_and_blocks(node):
+    port = node.rpc_server.bound_port
+    _wait_height(node, 2)
+    st = _rpc(port, "status")
+    assert st["node_info"]["network"] == "rpc-test-chain"
+    assert int(st["sync_info"]["latest_block_height"]) >= 2
+
+    blk = _rpc(port, "block", {"height": "1"})
+    assert blk["block"]["header"]["height"] == "1"
+    assert blk["block"]["header"]["chain_id"] == "rpc-test-chain"
+
+    # commit for height 1 verifies against the validator set
+    cm = _rpc(port, "commit", {"height": "1"})
+    assert cm["signed_header"]["commit"]["height"] == "1"
+
+    vals = _rpc(port, "validators")
+    assert vals["total"] == "1"
+
+    gen = _rpc(port, "genesis")
+    assert gen["genesis"]["chain_id"] == "rpc-test-chain"
+
+    health = _rpc(port, "health")
+    assert health == {}
+
+    abci = _rpc(port, "abci_info")
+    assert int(abci["response"]["last_block_height"]) >= 1
+
+
+def test_broadcast_tx_commit_roundtrip(node):
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    tx = base64.b64encode(b"rpckey=rpcval").decode()
+    res = _rpc(port, "broadcast_tx_commit", {"tx": tx})
+    assert res["tx_result"]["code"] == 0
+    assert int(res["height"]) >= 1
+
+    # query the applied state through abci_query
+    q = _rpc(
+        port,
+        "abci_query",
+        {"path": "/store", "data": b"rpckey".hex()},
+    )
+    assert base64.b64decode(q["response"]["value"]) == b"rpcval"
+
+    # block_results for that height contains the tx result
+    br = _rpc(port, "block_results", {"height": res["height"]})
+    assert len(br["txs_results"]) == 1
+    assert br["txs_results"][0]["code"] == 0
+
+
+def test_broadcast_tx_sync_and_unconfirmed(node):
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    tx = base64.b64encode(b"k2=v2").decode()
+    res = _rpc(port, "broadcast_tx_sync", {"tx": tx})
+    assert res["code"] == 0
+    # the tx eventually leaves the mempool (committed)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        n = int(_rpc(port, "num_unconfirmed_txs")["n_txs"])
+        if n == 0:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("tx stuck in mempool")
+
+
+def test_uri_get_routes(node):
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/block?height=1", timeout=10
+    ) as resp:
+        doc = json.loads(resp.read())
+    assert doc["result"]["block"]["header"]["height"] == "1"
+
+
+def test_restart_replays_state(tmp_path):
+    home = str(tmp_path / "restart-node")
+    assert cli_main(["--home", home, "init", "--chain-id", "restart-chain"]) == 0
+    cfg = cfgmod.load_config(home)
+    cfg.base.home = home
+    cfg.base.db_backend = "sqlite"
+    cfg.rpc.laddr = ""
+    cfg.consensus.timeout_commit_ms = 50
+    n = Node(cfg)
+    n.start()
+    _wait_height(n, 2)
+    h1 = n.block_store.height()
+    n.stop()
+
+    n2 = Node(cfg)
+    n2.start()
+    _wait_height(n2, h1 + 1, timeout=30)
+    assert n2.state_store.load().last_block_height >= h1
+    n2.stop()
