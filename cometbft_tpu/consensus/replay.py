@@ -38,12 +38,14 @@ class Handshaker:
         block_store,
         genesis_doc: GenesisDoc,
         event_bus=None,
+        evidence_pool=None,
         logger: Optional[liblog.Logger] = None,
     ):
         self.state_store = state_store
         self.block_store = block_store
         self.genesis_doc = genesis_doc
         self.event_bus = event_bus
+        self.evidence_pool = evidence_pool
         self.logger = logger or liblog.nop_logger()
         self.n_blocks_replayed = 0
 
@@ -137,6 +139,7 @@ class Handshaker:
                 self.block_store,
                 app_conns.consensus,
                 _ReplayMempool(),
+                evidence_pool=self.evidence_pool,
                 event_bus=self.event_bus,
                 logger=self.logger,
             )
@@ -155,6 +158,7 @@ class Handshaker:
         req = at.FinalizeBlockRequest(
             txs=list(block.data.txs),
             decided_last_commit=build_last_commit_info(block, last_vals),
+            misbehavior=[m for ev in block.evidence for m in ev.abci()],
             hash=block.hash(),
             height=height,
             time_unix_ns=block.header.time.to_ns(),

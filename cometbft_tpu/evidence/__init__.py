@@ -1,0 +1,3 @@
+from cometbft_tpu.evidence.pool import EvidencePool, EvidenceInvalidError
+
+__all__ = ["EvidencePool", "EvidenceInvalidError"]

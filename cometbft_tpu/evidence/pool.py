@@ -1,0 +1,191 @@
+"""Evidence pool (reference: internal/evidence/pool.go).
+
+Holds verified-but-uncommitted evidence for proposal inclusion and gossip,
+and remembers committed evidence so duplicates are rejected.  Conflicting
+votes reported by consensus are buffered and converted to
+``DuplicateVoteEvidence`` once the block for that height is finalized, when
+the pool has the state to attribute voting powers (reference:
+pool.go processConsensusBuffer).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from cometbft_tpu.evidence import verify as everify
+from cometbft_tpu.evidence.verify import EvidenceInvalidError
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    EvidenceError,
+)
+from cometbft_tpu.types.vote import Vote
+
+_PENDING = b"evp/"
+_COMMITTED = b"evc/"
+
+
+def _key(prefix: bytes, height: int, hash_: bytes) -> bytes:
+    return prefix + struct.pack(">q", height) + hash_
+
+
+class EvidencePool:
+    """Reference: internal/evidence/pool.go:24 Pool."""
+
+    def __init__(self, db, state_store, block_store, logger=None):
+        self._db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger or liblog.nop_logger()
+        self._mtx = threading.Lock()
+        self.state = state_store.load()
+        # consensus-reported vote pairs awaiting state to attribute power
+        self._consensus_buffer: list[tuple[Vote, Vote]] = []
+        # evidence added since last query, for the gossip reactor
+        self.evidence_waiter = threading.Event()
+
+    # -- ingest ------------------------------------------------------------
+
+    def add_evidence(self, ev) -> None:
+        """Verify and admit evidence from a peer or RPC (reference:
+        pool.go:190 AddEvidence)."""
+        with self._mtx:
+            if self._is_pending(ev) or self._is_committed(ev):
+                return  # already have it
+            if self.state is None:
+                raise EvidenceError("pool has no state yet")
+            everify.verify(ev, self.state, self.state_store, self.block_store)
+            self._add_pending(ev)
+            self.logger.info("added evidence", evidence=str(ev))
+            self.evidence_waiter.set()
+
+    def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
+        """Called by consensus on equivocation (reference: pool.go:145
+        ReportConflictingVotes) — buffered until the height is committed."""
+        with self._mtx:
+            self._consensus_buffer.append((vote_a, vote_b))
+
+    # -- block-validation hooks (reference: pool.go:248 CheckEvidence) -----
+
+    def check_evidence(self, state, evidence: list) -> None:
+        """Verify every piece of evidence in a proposed block; duplicates
+        within the block or against committed evidence are invalid."""
+        hashes = set()
+        for ev in evidence:
+            h = ev.hash()
+            if h in hashes:
+                raise EvidenceInvalidError("duplicate evidence in block")
+            hashes.add(h)
+            with self._mtx:
+                if self._is_committed(ev):
+                    raise EvidenceInvalidError("evidence was already committed")
+                if not self._is_pending(ev):
+                    everify.verify(
+                        ev, state, self.state_store, self.block_store
+                    )
+
+    # -- proposal supply ---------------------------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
+        """Reference: pool.go PendingEvidence — pending evidence up to
+        max_bytes, oldest first."""
+        out, size = [], 0
+        with self._mtx:
+            for _k, raw in self._db.iterate(_PENDING, _PENDING + b"\xff"):
+                ev = codec.decode_evidence(raw)
+                n = len(raw)
+                if max_bytes >= 0 and size + n > max_bytes:
+                    break
+                out.append(ev)
+                size += n
+        return out, size
+
+    # -- post-commit update (reference: pool.go Update) --------------------
+
+    def update(self, state, block_evidence: list) -> None:
+        with self._mtx:
+            self.state = state
+            for ev in block_evidence:
+                self._mark_committed(ev)
+            self._process_consensus_buffer(state)
+            self._prune_expired(state)
+
+    def _process_consensus_buffer(self, state) -> None:
+        """Convert buffered conflicting votes into evidence (reference:
+        pool.go processConsensusBuffer)."""
+        buf, self._consensus_buffer = self._consensus_buffer, []
+        for vote_a, vote_b in buf:
+            vals = self.state_store.load_validators(vote_a.height)
+            if vals is None:
+                continue
+            found = vals.get_by_address(vote_a.validator_address)
+            if found is None:
+                continue
+            _, val = found
+            meta = self.block_store.load_block_meta(vote_a.height)
+            block_time = meta.header.time if meta else state.last_block_time
+            ev = DuplicateVoteEvidence.from_votes(
+                vote_a,
+                vote_b,
+                block_time,
+                val.voting_power,
+                vals.total_voting_power(),
+            )
+            if self._is_pending(ev) or self._is_committed(ev):
+                continue
+            try:
+                everify.verify(ev, state, self.state_store, self.block_store)
+            except EvidenceError as e:
+                self.logger.error(
+                    "failed to verify consensus-reported evidence", err=str(e)
+                )
+                continue
+            self._add_pending(ev)
+            self.logger.info("equivocation evidence created", evidence=str(ev))
+            self.evidence_waiter.set()
+
+    def _prune_expired(self, state) -> None:
+        params = state.consensus_params.evidence
+        dels = []
+        for k, raw in self._db.iterate(_PENDING, _PENDING + b"\xff"):
+            height = struct.unpack(">q", k[len(_PENDING) : len(_PENDING) + 8])[0]
+            ev = codec.decode_evidence(raw)
+            age_blocks = state.last_block_height - height
+            age_ns = state.last_block_time.to_ns() - ev.time.to_ns()
+            if (
+                age_blocks > params.max_age_num_blocks
+                and age_ns > params.max_age_duration_ns
+            ):
+                dels.append(k)
+        # committed markers only record height; once past the height-age
+        # window no duplicate can be re-proposed, so the marker can go too
+        for k, _raw in self._db.iterate(_COMMITTED, _COMMITTED + b"\xff"):
+            height = struct.unpack(">q", k[len(_COMMITTED) : len(_COMMITTED) + 8])[0]
+            if state.last_block_height - height > params.max_age_num_blocks:
+                dels.append(k)
+        for k in dels:
+            self._db.delete(k)
+
+    # -- storage helpers ---------------------------------------------------
+
+    def _add_pending(self, ev) -> None:
+        self._db.set(_key(_PENDING, ev.height, ev.hash()), codec.encode_evidence(ev))
+
+    def _is_pending(self, ev) -> bool:
+        return self._db.get(_key(_PENDING, ev.height, ev.hash())) is not None
+
+    def _is_committed(self, ev) -> bool:
+        return self._db.get(_key(_COMMITTED, ev.height, ev.hash())) is not None
+
+    def _mark_committed(self, ev) -> None:
+        self._db.set(_key(_COMMITTED, ev.height, ev.hash()), b"\x01")
+        self._db.delete(_key(_PENDING, ev.height, ev.hash()))
+
+    # -- introspection -----------------------------------------------------
+
+    def all_pending(self) -> list:
+        evs, _ = self.pending_evidence(-1)
+        return evs

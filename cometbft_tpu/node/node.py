@@ -100,16 +100,28 @@ class Node(BaseService):
         # -- event bus ------------------------------------------------------
         self.event_bus = EventBus()
 
+        # -- evidence pool (reference: node/node.go:431 createEvidenceReactor)
+        from cometbft_tpu.evidence.pool import EvidencePool
+
+        self.evidence_pool = EvidencePool(
+            self.db,
+            self.state_store,
+            self.block_store,
+            logger=self.logger.with_(module="evidence"),
+        )
+
         # -- handshake (reference: node/node.go:411 doHandshake) ------------
         handshaker = Handshaker(
             self.state_store,
             self.block_store,
             self.genesis_doc,
             event_bus=self.event_bus,
+            evidence_pool=self.evidence_pool,
             logger=self.logger.with_(module="handshaker"),
         )
         state = handshaker.handshake(state, self.proxy_app)
         self.state = state
+        self.evidence_pool.state = state
 
         # -- mempool --------------------------------------------------------
         info = self.proxy_app.query.info()
@@ -132,6 +144,7 @@ class Node(BaseService):
             self.block_store,
             self.proxy_app.consensus,
             self.mempool,
+            evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
             logger=self.logger.with_(module="state"),
         )
@@ -147,6 +160,7 @@ class Node(BaseService):
             priv_validator=self.priv_validator,
             wal=WAL(wal_path),
             event_bus=self.event_bus,
+            evidence_pool=self.evidence_pool,
             logger=self.logger.with_(module="consensus"),
         )
 

@@ -88,11 +88,48 @@ def _commit_json(c) -> dict:
     }
 
 
+def _evidence_json(ev) -> dict:
+    from cometbft_tpu.types import codec as _codec
+
+    return {
+        "type": ev.TYPE,
+        "height": str(ev.height),
+        "time": _ts_json(ev.time),
+        "total_voting_power": str(ev.total_voting_power),
+        "bytes": _b64(_codec.encode_evidence(ev)),
+    }
+
+
+class QuotedString(str):
+    """A URI argument that arrived double-quoted: a raw string literal,
+    never hex/base64 (reference: rpc/jsonrpc/server/uri.go)."""
+
+
+def _bytes_arg(v) -> bytes:
+    """Decode a bytes-typed RPC argument with the reference's conventions
+    (rpc/jsonrpc/server/uri.go): URI quoted string -> raw bytes of the
+    string, 0x/hex -> hex decode, otherwise base64 (JSON-RPC body form)."""
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    if v is None or v == "":
+        return b""
+    if isinstance(v, QuotedString):
+        return str(v).encode()
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        return v[1:-1].encode()
+    h = v[2:] if v.startswith("0x") else v
+    try:
+        return bytes.fromhex(h)
+    except ValueError:
+        pass
+    return base64.b64decode(v)
+
+
 def _block_json(block) -> dict:
     return {
         "header": _header_json(block.header),
         "data": {"txs": [_b64(tx) for tx in block.data.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {"evidence": [_evidence_json(ev) for ev in block.evidence]},
         "last_commit": _commit_json(block.last_commit),
     }
 
@@ -228,7 +265,7 @@ class Environment:
         }
 
     def block_by_hash(self, hash_: str) -> dict:
-        raw = bytes.fromhex(hash_)
+        raw = _bytes_arg(hash_)
         block = self.node.block_store.load_block_by_hash(raw)
         if block is None:
             raise RPCError(-32603, "block not found")
@@ -410,7 +447,7 @@ class Environment:
         height: int = 0,
         prove: bool = False,
     ) -> dict:
-        raw = bytes.fromhex(data) if data else b""
+        raw = _bytes_arg(data)
         res = self.node.proxy_app.query.query(
             at.QueryRequest(data=raw, path=path, height=height, prove=prove)
         )
@@ -438,7 +475,7 @@ class Environment:
             raise RPCError(-32603, f"mempool error: {e}")
 
     def broadcast_tx_async(self, tx: str) -> dict:
-        raw = base64.b64decode(tx)
+        raw = _bytes_arg(tx)
         import threading
 
         threading.Thread(
@@ -453,7 +490,7 @@ class Environment:
             pass
 
     def broadcast_tx_sync(self, tx: str) -> dict:
-        raw = base64.b64decode(tx)
+        raw = _bytes_arg(tx)
         res = self._check_tx_to_mempool(raw)
         return {
             "code": res.code,
@@ -466,7 +503,7 @@ class Environment:
     def broadcast_tx_commit(self, tx: str) -> dict:
         """CheckTx then wait for the tx to be committed (reference:
         rpc/core/mempool.go BroadcastTxCommit)."""
-        raw = base64.b64decode(tx)
+        raw = _bytes_arg(tx)
         tx_hash = tmhash.sum256(raw)
         q = Query.parse(
             f"{tev.EVENT_TYPE_KEY}='{tev.EVENT_TX}' AND "
@@ -520,7 +557,7 @@ class Environment:
         }
 
     def check_tx(self, tx: str) -> dict:
-        raw = base64.b64decode(tx)
+        raw = _bytes_arg(tx)
         res = self.node.proxy_app.mempool.check_tx(at.CheckTxRequest(tx=raw))
         return {"code": res.code, "log": res.log, "gas_wanted": str(res.gas_wanted)}
 
@@ -530,7 +567,7 @@ class Environment:
         indexer = getattr(self.node, "tx_indexer", None)
         if indexer is None:
             raise RPCError(-32603, "transaction indexing is disabled")
-        raw_hash = bytes.fromhex(hash_)
+        raw_hash = _bytes_arg(hash_)
         result = indexer.get(raw_hash)
         if result is None:
             raise RPCError(-32603, f"tx {hash_} not found")

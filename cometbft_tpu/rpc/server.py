@@ -120,9 +120,12 @@ class RPCServer:
                     )
                     return
                 params = dict(parse_qsl(url.query))
-                # URI params arrive quoted: strip quotes from strings
+                # URI string params arrive quoted: strip the quotes but keep
+                # the fact that they were quoted (bytes args decode raw)
                 params = {
-                    k: v.strip('"') if isinstance(v, str) else v
+                    k: rpccore.QuotedString(v[1:-1])
+                    if len(v) >= 2 and v[0] == '"' and v[-1] == '"'
+                    else v
                     for k, v in params.items()
                 }
                 self._dispatch(name, params, id_=-1)
@@ -242,6 +245,9 @@ def _ws_send(conn: socket.socket, payload: bytes, opcode: int = 1) -> None:
     conn.sendall(header + payload)
 
 
+_WS_MAX_FRAME = 16 * 1024 * 1024  # cap attacker-declared frame lengths
+
+
 def _ws_recv(conn: socket.socket) -> Optional[tuple[int, bytes]]:
     def read_exact(k: int) -> Optional[bytes]:
         buf = b""
@@ -268,6 +274,8 @@ def _ws_recv(conn: socket.socket) -> Optional[tuple[int, bytes]]:
         if ext is None:
             return None
         n = struct.unpack(">Q", ext)[0]
+    if n > _WS_MAX_FRAME:
+        return None  # oversized frame: drop the connection
     mask = b"\x00" * 4
     if masked:
         mask = read_exact(4)

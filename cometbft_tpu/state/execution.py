@@ -190,7 +190,7 @@ class BlockExecutor:
             max_tx_bytes=max_data_bytes,
             txs=txs,
             local_last_commit=last_ext_commit_info or at.ExtendedCommitInfo(),
-            misbehavior=[ev.abci() for ev in evidence],
+            misbehavior=[m for ev in evidence for m in ev.abci()],
             height=height,
             time_unix_ns=time.to_ns(),
             next_validators_hash=state.next_validators.hash(),
@@ -204,7 +204,12 @@ class BlockExecutor:
                 f"app returned {total}B of txs > limit {max_data_bytes}B"
             )
         block = make_block(height, list(new_txs), last_commit, state, proposer_address, time)
+        # attach evidence BEFORE the hashes are trusted: evidence_hash was
+        # filled for an empty list inside make_block, recompute it
         block.evidence = evidence
+        from cometbft_tpu.types.evidence import evidence_list_hash
+
+        block.header.evidence_hash = evidence_list_hash(evidence)
         return block
 
     # -- proposal validation (reference :173 ProcessProposal) -------------
@@ -213,7 +218,7 @@ class BlockExecutor:
         req = at.ProcessProposalRequest(
             txs=list(block.data.txs),
             proposed_last_commit=build_last_commit_info(block, state.last_validators),
-            misbehavior=[ev.abci() for ev in block.evidence],
+            misbehavior=[m for ev in block.evidence for m in ev.abci()],
             hash=block.hash(),
             height=block.header.height,
             time_unix_ns=block.header.time.to_ns(),
@@ -279,6 +284,24 @@ class BlockExecutor:
         ):
             raise InvalidBlockError("proposer not in validator set")
 
+        # evidence: size limit + full verification against the pool
+        # (reference: state/validation.go:17 validateBlock evidence section)
+        from cometbft_tpu.types.evidence import evidence_list_bytes
+
+        ev_bytes = evidence_list_bytes(block.evidence)
+        if ev_bytes > state.consensus_params.evidence.max_bytes:
+            raise InvalidBlockError(
+                f"evidence bytes {ev_bytes} > limit "
+                f"{state.consensus_params.evidence.max_bytes}"
+            )
+        if self.evidence_pool is not None:
+            from cometbft_tpu.evidence.verify import EvidenceInvalidError
+
+            try:
+                self.evidence_pool.check_evidence(state, block.evidence)
+            except EvidenceInvalidError as e:
+                raise InvalidBlockError(f"invalid evidence: {e}") from e
+
     # -- ApplyBlock (reference :224-334) ----------------------------------
 
     def apply_block(
@@ -294,7 +317,7 @@ class BlockExecutor:
         req = at.FinalizeBlockRequest(
             txs=list(block.data.txs),
             decided_last_commit=build_last_commit_info(block, state.last_validators),
-            misbehavior=[ev.abci() for ev in block.evidence],
+            misbehavior=[m for ev in block.evidence for m in ev.abci()],
             hash=block.hash(),
             height=h.height,
             time_unix_ns=h.time.to_ns(),

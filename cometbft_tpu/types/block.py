@@ -209,4 +209,8 @@ class Block:
             return "last commit hash mismatch"
         if self.header.data_hash != self.data.hash():
             return "data hash mismatch"
+        if self.header.evidence_hash != merkle.hash_from_byte_slices(
+            [ev.hash() for ev in self.evidence]
+        ):
+            return "evidence hash mismatch"
         return None

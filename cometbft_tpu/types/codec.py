@@ -148,11 +148,14 @@ def decode_data(body: bytes) -> Data:
 
 def encode_block(b: Block) -> bytes:
     b.fill_header_hashes()
+    ev_list = b"".join(
+        pe.t_message(1, encode_evidence(ev), always=True) for ev in b.evidence
+    )
     return b"".join(
         [
             pe.t_message(1, encode_header(b.header), always=True),
             pe.t_message(2, encode_data(b.data), always=True),
-            pe.t_message(3, b"", always=True),  # evidence list (placeholder)
+            pe.t_message(3, ev_list, always=True),
             pe.t_message(4, encode_commit(b.last_commit), always=True),
         ]
     )
@@ -160,11 +163,15 @@ def encode_block(b: Block) -> bytes:
 
 def decode_block(body: bytes) -> Block:
     f = pe.fields_dict(body)
+    evidence = []
+    if 3 in f:
+        ef = pe.fields_dict(f[3][-1])
+        evidence = [decode_evidence(e) for e in ef.get(1, [])]
     return Block(
         header=decode_header(f[1][-1]),
         data=decode_data(f[2][-1]) if 2 in f else Data(),
         last_commit=decode_commit(f[4][-1]) if 4 in f else Commit(0, 0, BlockID(), []),
-        evidence=[],
+        evidence=evidence,
     )
 
 
@@ -226,3 +233,152 @@ def decode_proposal(body: bytes) -> Proposal:
         timestamp=decode_timestamp(f[5][-1]) if 5 in f else Timestamp(),
         signature=bytes(f.get(6, [b""])[-1]),
     )
+
+
+# -- validators / validator sets (wire form for evidence + light blocks) ----
+
+def encode_validator(v) -> bytes:
+    """Proto Validator{pub_key{type=key}, voting_power, proposer_priority}
+    (reference: proto/cometbft/types/validator.proto)."""
+    key_field = {"ed25519": 1, "secp256k1": 2, "bls12_381": 3}[v.pub_key.type_]
+    pub = pe.t_bytes(key_field, v.pub_key.bytes())
+    return (
+        pe.t_message(1, pub, always=True)
+        + pe.t_varint(2, v.voting_power)
+        + pe.t_varint(3, v.proposer_priority)
+    )
+
+
+def decode_validator(body: bytes):
+    from cometbft_tpu.crypto import keys as ck
+    from cometbft_tpu.types.validator import Validator
+
+    f = pe.fields_dict(body)
+    pf = pe.fields_dict(f[1][-1])
+    for field_num, key_type in ((1, "ed25519"), (2, "secp256k1"), (3, "bls12_381")):
+        if field_num in pf:
+            pub = ck.pub_key_from_type(key_type, bytes(pf[field_num][-1]))
+            break
+    else:
+        raise ValueError("validator has no public key")
+    return Validator(
+        pub_key=pub,
+        voting_power=pe.to_int64(f.get(2, [0])[-1]),
+        proposer_priority=pe.to_int64(f.get(3, [0])[-1]),
+    )
+
+
+def encode_validator_set(vals) -> bytes:
+    out = [pe.t_message(1, encode_validator(v), always=True) for v in vals.validators]
+    out.append(pe.t_message(2, encode_validator(vals.get_proposer()), always=True))
+    return b"".join(out)
+
+
+def decode_validator_set(body: bytes):
+    from cometbft_tpu.types.validator import ValidatorSet
+
+    f = pe.fields_dict(body)
+    vs = ValidatorSet.__new__(ValidatorSet)
+    vals = [decode_validator(v) for v in f.get(1, [])]
+    # bypass __init__ (which re-increments proposer priorities) to preserve
+    # the wire-carried priorities exactly
+    vs.validators = vals
+    vs.proposer = decode_validator(f[2][-1]) if 2 in f else None
+    vs._total_voting_power = None
+    return vs
+
+
+# -- signed headers / light blocks ------------------------------------------
+
+def encode_signed_header(sh) -> bytes:
+    return pe.t_message(1, encode_header(sh.header), always=True) + pe.t_message(
+        2, encode_commit(sh.commit), always=True
+    )
+
+
+def decode_signed_header(body: bytes):
+    from cometbft_tpu.types.light import SignedHeader
+
+    f = pe.fields_dict(body)
+    return SignedHeader(
+        header=decode_header(f[1][-1]),
+        commit=decode_commit(f[2][-1]),
+    )
+
+
+def encode_light_block(lb) -> bytes:
+    return pe.t_message(
+        1, encode_signed_header(lb.signed_header), always=True
+    ) + pe.t_message(2, encode_validator_set(lb.validator_set), always=True)
+
+
+def decode_light_block(body: bytes):
+    from cometbft_tpu.types.light import LightBlock
+
+    f = pe.fields_dict(body)
+    return LightBlock(
+        signed_header=decode_signed_header(f[1][-1]),
+        validator_set=decode_validator_set(f[2][-1]),
+    )
+
+
+# -- evidence ----------------------------------------------------------------
+
+def encode_evidence(ev) -> bytes:
+    """Proto Evidence oneof: 1=DuplicateVoteEvidence, 2=LightClientAttackEvidence
+    (reference: proto/cometbft/types/evidence.proto)."""
+    from cometbft_tpu.types.evidence import (
+        DuplicateVoteEvidence,
+        LightClientAttackEvidence,
+    )
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        body = (
+            pe.t_message(1, encode_vote(ev.vote_a), always=True)
+            + pe.t_message(2, encode_vote(ev.vote_b), always=True)
+            + pe.t_varint(3, ev.total_voting_power)
+            + pe.t_varint(4, ev.validator_power)
+            + pe.t_message(5, ev.timestamp.encode())
+        )
+        return pe.t_message(1, body, always=True)
+    if isinstance(ev, LightClientAttackEvidence):
+        body = (
+            pe.t_message(1, encode_light_block(ev.conflicting_block), always=True)
+            + pe.t_varint(2, ev.common_height)
+            + b"".join(
+                pe.t_message(3, encode_validator(v), always=True)
+                for v in ev.byzantine_validators
+            )
+            + pe.t_varint(4, ev.total_voting_power)
+            + pe.t_message(5, ev.timestamp.encode())
+        )
+        return pe.t_message(2, body, always=True)
+    raise TypeError(f"cannot encode evidence {type(ev).__name__}")
+
+
+def decode_evidence(body: bytes):
+    from cometbft_tpu.types.evidence import (
+        DuplicateVoteEvidence,
+        LightClientAttackEvidence,
+    )
+
+    f = pe.fields_dict(body)
+    if 1 in f:
+        df = pe.fields_dict(f[1][-1])
+        return DuplicateVoteEvidence(
+            vote_a=decode_vote(df[1][-1]),
+            vote_b=decode_vote(df[2][-1]),
+            total_voting_power=pe.to_int64(df.get(3, [0])[-1]),
+            validator_power=pe.to_int64(df.get(4, [0])[-1]),
+            timestamp=decode_timestamp(df[5][-1]) if 5 in df else Timestamp(),
+        )
+    if 2 in f:
+        lf = pe.fields_dict(f[2][-1])
+        return LightClientAttackEvidence(
+            conflicting_block=decode_light_block(lf[1][-1]),
+            common_height=pe.to_int64(lf.get(2, [0])[-1]),
+            byzantine_validators=[decode_validator(v) for v in lf.get(3, [])],
+            total_voting_power=pe.to_int64(lf.get(4, [0])[-1]),
+            timestamp=decode_timestamp(lf[5][-1]) if 5 in lf else Timestamp(),
+        )
+    raise ValueError("unknown evidence kind")

@@ -1,0 +1,203 @@
+"""Evidence subsystem tests (reference test model:
+internal/evidence/{pool,verify}_test.go, types/evidence_test.go)."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.evidence.pool import EvidencePool
+from cometbft_tpu.evidence.verify import (
+    EvidenceInvalidError,
+    verify_duplicate_vote,
+)
+from cometbft_tpu.state.state import state_from_genesis
+from cometbft_tpu.state.store import StateStore
+from cometbft_tpu.store.block_store import BlockStore
+from cometbft_tpu.store.kv import MemKV
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.basic import (
+    PRECOMMIT_TYPE,
+    BlockID,
+    PartSetHeader,
+    Timestamp,
+)
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.validator import Validator, ValidatorSet
+
+CHAIN_ID = "ev-test-chain"
+
+
+def _privs(n):
+    return [
+        Ed25519PrivKey.from_seed(hashlib.sha256(b"evval%d" % i).digest())
+        for i in range(n)
+    ]
+
+
+def _valset(privs):
+    return ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+
+
+def _block_id(tag: bytes) -> BlockID:
+    return BlockID(
+        hash=hashlib.sha256(tag).digest(),
+        part_set_header=PartSetHeader(total=1, hash=hashlib.sha256(tag + b"p").digest()),
+    )
+
+
+def _signed_vote(priv, valset, height, round_, block_id, ts=None):
+    from cometbft_tpu.types.vote import Vote
+
+    addr = priv.pub_key().address()
+    idx, _ = valset.get_by_address(addr)
+    vote = Vote(
+        type_=PRECOMMIT_TYPE,
+        height=height,
+        round_=round_,
+        block_id=block_id,
+        timestamp=ts or Timestamp(100, 0),
+        validator_address=addr,
+        validator_index=idx,
+    )
+    vote.signature = priv.sign(vote.sign_bytes(CHAIN_ID))
+    return vote
+
+
+def _dupe_evidence(privs, valset, height=1):
+    v1 = _signed_vote(privs[0], valset, height, 0, _block_id(b"a"))
+    v2 = _signed_vote(privs[0], valset, height, 0, _block_id(b"b"))
+    return DuplicateVoteEvidence.from_votes(
+        v1, v2, Timestamp(100, 0), 10, valset.total_voting_power()
+    )
+
+
+class TestDuplicateVoteEvidence:
+    def test_roundtrip_and_hash(self):
+        privs = _privs(3)
+        valset = _valset(privs)
+        ev = _dupe_evidence(privs, valset)
+        raw = codec.encode_evidence(ev)
+        ev2 = codec.decode_evidence(raw)
+        assert ev2.hash() == ev.hash()
+        assert ev2.vote_a.signature == ev.vote_a.signature
+        assert ev2.total_voting_power == ev.total_voting_power
+
+    def test_block_with_evidence_roundtrip(self):
+        from cometbft_tpu.types.block import Block, Data, Header, ConsensusVersion, empty_commit
+
+        privs = _privs(3)
+        valset = _valset(privs)
+        ev = _dupe_evidence(privs, valset)
+        header = Header(
+            version=ConsensusVersion(block=11),
+            chain_id=CHAIN_ID,
+            height=2,
+            time=Timestamp(5, 0),
+            last_block_id=_block_id(b"prev"),
+            validators_hash=valset.hash(),
+        )
+        block = Block(header=header, data=Data(txs=[b"tx1"]), last_commit=empty_commit(), evidence=[ev])
+        raw = block.encode()
+        block2 = codec.decode_block(raw)
+        assert len(block2.evidence) == 1
+        assert block2.evidence[0].hash() == ev.hash()
+        assert block2.hash() == block.hash()
+
+    def test_verify_ok(self):
+        privs = _privs(3)
+        valset = _valset(privs)
+        ev = _dupe_evidence(privs, valset)
+        verify_duplicate_vote(ev, CHAIN_ID, valset)  # no raise
+
+    def test_verify_rejects_same_block_id(self):
+        privs = _privs(3)
+        valset = _valset(privs)
+        v1 = _signed_vote(privs[0], valset, 1, 0, _block_id(b"a"))
+        ev = DuplicateVoteEvidence(vote_a=v1, vote_b=v1, validator_power=10,
+                                   total_voting_power=30)
+        with pytest.raises(EvidenceInvalidError):
+            verify_duplicate_vote(ev, CHAIN_ID, valset)
+
+    def test_verify_rejects_bad_signature(self):
+        privs = _privs(3)
+        valset = _valset(privs)
+        ev = _dupe_evidence(privs, valset)
+        ev.vote_b.signature = bytes(64)
+        with pytest.raises(EvidenceInvalidError):
+            verify_duplicate_vote(ev, CHAIN_ID, valset)
+
+    def test_verify_rejects_wrong_power(self):
+        privs = _privs(3)
+        valset = _valset(privs)
+        ev = _dupe_evidence(privs, valset)
+        ev.validator_power = 99
+        with pytest.raises(EvidenceInvalidError):
+            verify_duplicate_vote(ev, CHAIN_ID, valset)
+
+
+class TestEvidencePool:
+    def _setup(self):
+        privs = _privs(3)
+        gdoc = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=Timestamp(0, 0),
+            validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+        )
+        db = MemKV()
+        state_store = StateStore(db)
+        block_store = BlockStore(db)
+        state = state_from_genesis(gdoc)
+        state_store.save(state)  # saves validators for heights 1,2
+        pool = EvidencePool(db, state_store, block_store)
+        valset = state.validators
+        return privs, state, pool, valset
+
+    def test_add_pending_commit_lifecycle(self):
+        privs, state, pool, valset = self._setup()
+        ev = _dupe_evidence(privs, valset)
+        pool.add_evidence(ev)
+        pending, size = pool.pending_evidence(1048576)
+        assert len(pending) == 1 and size > 0
+        assert pending[0].hash() == ev.hash()
+
+        # re-add is a no-op
+        pool.add_evidence(ev)
+        assert len(pool.all_pending()) == 1
+
+        # check passes pre-commit
+        pool.check_evidence(state, [ev])
+
+        # commit it
+        pool.update(state, [ev])
+        assert pool.all_pending() == []
+        with pytest.raises(EvidenceInvalidError):
+            pool.check_evidence(state, [ev])
+
+    def test_add_rejects_tampered(self):
+        privs, state, pool, valset = self._setup()
+        ev = _dupe_evidence(privs, valset)
+        ev.validator_power = 3
+        from cometbft_tpu.types.evidence import EvidenceError
+
+        with pytest.raises(EvidenceError):
+            pool.add_evidence(ev)
+        assert pool.all_pending() == []
+
+    def test_consensus_buffer_flow(self):
+        privs, state, pool, valset = self._setup()
+        v1 = _signed_vote(privs[1], valset, 1, 0, _block_id(b"x"))
+        v2 = _signed_vote(privs[1], valset, 1, 0, _block_id(b"y"))
+        pool.report_conflicting_votes(v1, v2)
+        assert pool.all_pending() == []  # buffered, not yet materialized
+        pool.update(state, [])
+        pending = pool.all_pending()
+        assert len(pending) == 1
+        assert pending[0].vote_a.validator_address == privs[1].pub_key().address()
+
+    def test_duplicate_in_block_rejected(self):
+        privs, state, pool, valset = self._setup()
+        ev = _dupe_evidence(privs, valset)
+        with pytest.raises(EvidenceInvalidError):
+            pool.check_evidence(state, [ev, ev])
