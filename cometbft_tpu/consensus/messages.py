@@ -194,3 +194,149 @@ def decode_timeout_wal(raw: bytes):
         fields.get(3, [0])[0],
         fields.get(4, [0])[0],
     )
+
+
+# -- gossip message serialization (reactor channels 0x20-0x23) ---------------
+
+def _encode_bits(bits: list[bool]) -> bytes:
+    n = len(bits)
+    packed = bytearray((n + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            packed[i // 8] |= 1 << (i % 8)
+    return pe.t_varint(1, n) + pe.t_bytes(2, bytes(packed))
+
+
+def _decode_bits(body: bytes) -> list[bool]:
+    f = pe.fields_dict(body)
+    n = f.get(1, [0])[-1]
+    packed = f.get(2, [b""])[-1]
+    return [
+        bool(packed[i // 8] & (1 << (i % 8))) if i // 8 < len(packed) else False
+        for i in range(n)
+    ]
+
+
+def _encode_psh(psh) -> bytes:
+    return pe.t_varint(1, psh.total) + pe.t_bytes(2, psh.hash)
+
+
+def _decode_psh(body: bytes):
+    from cometbft_tpu.types.basic import PartSetHeader
+
+    f = pe.fields_dict(body)
+    return PartSetHeader(total=f.get(1, [0])[-1], hash=bytes(f.get(2, [b""])[-1]))
+
+
+def encode_gossip_msg(msg: object) -> bytes:
+    """Tagged encoding for the reactor's state/data/vote channels
+    (reference: internal/consensus/msgs.go MsgToProto)."""
+    if isinstance(msg, (ProposalMessage, BlockPartMessage, VoteMessage)):
+        return encode_msg(msg)
+    if isinstance(msg, NewRoundStepMessage):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.round_)
+            + pe.t_varint(3, msg.step)
+            + pe.t_varint(4, msg.seconds_since_start_time)
+            + pe.t_varint(5, msg.last_commit_round + 1)
+        )
+        return bytes([MSG_NEW_ROUND_STEP]) + body
+    if isinstance(msg, NewValidBlockMessage):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.round_)
+            + pe.t_message(3, _encode_psh(msg.block_part_set_header), always=True)
+            + pe.t_message(4, _encode_bits(msg.blockparts), always=True)
+            + pe.t_varint(5, 1 if msg.is_commit else 0)
+        )
+        return bytes([MSG_NEW_VALID_BLOCK]) + body
+    if isinstance(msg, HasVoteMessage):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.round_)
+            + pe.t_varint(3, msg.type_)
+            + pe.t_varint(4, msg.index + 1)
+        )
+        return bytes([MSG_HAS_VOTE]) + body
+    if isinstance(msg, VoteSetMaj23Message):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.round_)
+            + pe.t_varint(3, msg.type_)
+            + pe.t_message(4, msg.block_id.encode(), always=True)
+        )
+        return bytes([MSG_VOTE_SET_MAJ23]) + body
+    if isinstance(msg, VoteSetBitsMessage):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.round_)
+            + pe.t_varint(3, msg.type_)
+            + pe.t_message(4, msg.block_id.encode(), always=True)
+            + pe.t_message(5, _encode_bits(msg.votes), always=True)
+        )
+        return bytes([MSG_VOTE_SET_BITS]) + body
+    if isinstance(msg, ProposalPOLMessage):
+        body = (
+            pe.t_varint(1, msg.height)
+            + pe.t_varint(2, msg.proposal_pol_round)
+            + pe.t_message(3, _encode_bits(msg.proposal_pol), always=True)
+        )
+        return bytes([MSG_PROPOSAL_POL]) + body
+    raise TypeError(f"cannot encode {type(msg).__name__}")
+
+
+def decode_gossip_msg(raw: bytes) -> object:
+    from cometbft_tpu.types import codec as _codec
+    from cometbft_tpu.types.codec import decode_block_id
+
+    kind = raw[0]
+    if kind in (MSG_PROPOSAL, MSG_BLOCK_PART, MSG_VOTE):
+        return decode_msg(raw)
+    body = raw[1:]
+    f = pe.fields_dict(body)
+    if kind == MSG_NEW_ROUND_STEP:
+        return NewRoundStepMessage(
+            height=pe.to_int64(f.get(1, [0])[-1]),
+            round_=f.get(2, [0])[-1],
+            step=f.get(3, [0])[-1],
+            seconds_since_start_time=f.get(4, [0])[-1],
+            last_commit_round=f.get(5, [0])[-1] - 1,
+        )
+    if kind == MSG_NEW_VALID_BLOCK:
+        return NewValidBlockMessage(
+            height=pe.to_int64(f.get(1, [0])[-1]),
+            round_=f.get(2, [0])[-1],
+            block_part_set_header=_decode_psh(f[3][-1]),
+            blockparts=_decode_bits(f[4][-1]) if 4 in f else [],
+            is_commit=bool(f.get(5, [0])[-1]),
+        )
+    if kind == MSG_HAS_VOTE:
+        return HasVoteMessage(
+            height=pe.to_int64(f.get(1, [0])[-1]),
+            round_=f.get(2, [0])[-1],
+            type_=f.get(3, [0])[-1],
+            index=f.get(4, [0])[-1] - 1,
+        )
+    if kind == MSG_VOTE_SET_MAJ23:
+        return VoteSetMaj23Message(
+            height=pe.to_int64(f.get(1, [0])[-1]),
+            round_=f.get(2, [0])[-1],
+            type_=f.get(3, [0])[-1],
+            block_id=decode_block_id(f[4][-1]) if 4 in f else BlockID(),
+        )
+    if kind == MSG_VOTE_SET_BITS:
+        return VoteSetBitsMessage(
+            height=pe.to_int64(f.get(1, [0])[-1]),
+            round_=f.get(2, [0])[-1],
+            type_=f.get(3, [0])[-1],
+            block_id=decode_block_id(f[4][-1]) if 4 in f else BlockID(),
+            votes=_decode_bits(f[5][-1]) if 5 in f else [],
+        )
+    if kind == MSG_PROPOSAL_POL:
+        return ProposalPOLMessage(
+            height=pe.to_int64(f.get(1, [0])[-1]),
+            proposal_pol_round=f.get(2, [0])[-1],
+            proposal_pol=_decode_bits(f[3][-1]) if 3 in f else [],
+        )
+    raise ValueError(f"unknown gossip message kind {kind}")

@@ -109,10 +109,19 @@ class ConsensusState(BaseService):
         self.broadcast_hook: Optional[Callable[[object], None]] = None
         # test hook: observe each (height, round, step) transition
         self.step_hook: Optional[Callable[[RoundState], None]] = None
+        # reactor listeners (reference: reactor subscribes to internal
+        # NewRoundStep/Vote events, reactor.go:1009 subscribeToBroadcastEvents)
+        self._step_listeners: list[Callable[[RoundState], None]] = []
+        self._vote_listeners: list[Callable[[Vote], None]] = []
 
         self._priv_addr: Optional[bytes] = None
         if priv_validator is not None:
             self._priv_addr = priv_validator.pub_key().address()
+
+        # block parts that arrived before we learned the part-set header
+        # (catchup: gossiped parts can beat the commit votes that carry the
+        # header in their block id); drained once the PartSet exists
+        self._orphan_parts: list = []
 
         self.update_to_state(state)
 
@@ -238,8 +247,8 @@ class ConsensusState(BaseService):
                 self._set_proposal(msg.proposal)
             elif isinstance(msg, BlockPartMessage):
                 added = self._add_proposal_block_part(msg)
-                if added and self.rs.proposal_complete():
-                    self._handle_complete_proposal(msg.height)
+                if added:
+                    self._on_block_part_added(msg.height)
             elif isinstance(msg, VoteMessage):
                 self._try_add_vote(msg.vote, mi.peer_id)
 
@@ -288,6 +297,12 @@ class ConsensusState(BaseService):
     # state transitions
     # ------------------------------------------------------------------
 
+    def add_step_listener(self, fn: Callable[[RoundState], None]) -> None:
+        self._step_listeners.append(fn)
+
+    def add_vote_listener(self, fn: Callable[[Vote], None]) -> None:
+        self._vote_listeners.append(fn)
+
     def _new_step(self) -> None:
         if self.event_bus:
             self.event_bus.publish_new_round_step(
@@ -297,6 +312,11 @@ class ConsensusState(BaseService):
             )
         if self.step_hook is not None:
             self.step_hook(self.rs)
+        for fn in self._step_listeners:
+            try:
+                fn(self.rs)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("step listener failed", err=repr(e))
 
     def _schedule_round0(self) -> None:
         """Wait until start_time then enter round 0 (reference:
@@ -559,6 +579,7 @@ class ConsensusState(BaseService):
         ):
             rs.proposal_block = None
             rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            self._drain_orphan_parts()
         self._sign_add_vote(PRECOMMIT_TYPE, b"", None)
 
     def _enter_precommit_wait(self, height: int, round_: int) -> None:
@@ -605,6 +626,7 @@ class ConsensusState(BaseService):
             ):
                 rs.proposal_block = None
                 rs.proposal_block_parts = PartSet(block_id.part_set_header)
+                self._drain_orphan_parts()
             return
 
         self._try_finalize_commit(height)
@@ -700,6 +722,7 @@ class ConsensusState(BaseService):
         rs.last_commit = last_precommits
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
+        self._orphan_parts = []
         self._new_step()
 
     # ------------------------------------------------------------------
@@ -725,6 +748,7 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
+            self._drain_orphan_parts()
         self.logger.debug(
             "received proposal", height=proposal.height, round=proposal.round_
         )
@@ -735,6 +759,10 @@ class ConsensusState(BaseService):
         if msg.height != rs.height:
             return False
         if rs.proposal_block_parts is None:
+            # no part-set header yet — keep the part; it is validated
+            # against the header's merkle root when drained
+            if len(self._orphan_parts) < 512:
+                self._orphan_parts.append(msg)
             return False
         added, err = rs.proposal_block_parts.add_part(msg.part)
         if err:
@@ -757,6 +785,31 @@ class ConsensusState(BaseService):
                     )
                 )
         return added
+
+    def _drain_orphan_parts(self) -> None:
+        """Re-add parts that arrived before the part-set header was known."""
+        if not self._orphan_parts or self.rs.proposal_block_parts is None:
+            return
+        pending, self._orphan_parts = self._orphan_parts, []
+        added_any = False
+        for msg in pending:
+            try:
+                if self._add_proposal_block_part(msg):
+                    added_any = True
+            except VoteError:
+                continue  # part doesn't match the header's merkle root
+        if added_any:
+            self._on_block_part_added(self.rs.height)
+
+    def _on_block_part_added(self, height: int) -> None:
+        """Dispatch after a part lands (reference: addProposalBlockPart's
+        completion handling, state.go:2129-2214): at commit step a complete
+        BLOCK suffices — a Proposal message is never required to finalize."""
+        rs = self.rs
+        if rs.step == STEP_COMMIT:
+            self._try_finalize_commit(height)
+        elif rs.proposal_complete():
+            self._handle_complete_proposal(height)
 
     def _handle_complete_proposal(self, height: int) -> None:
         """Reference: state.go:2214 handleCompleteProposal."""
@@ -827,6 +880,11 @@ class ConsensusState(BaseService):
             return
         if self.event_bus:
             self.event_bus.publish_vote(EventDataVote(vote))
+        for fn in self._vote_listeners:
+            try:
+                fn(vote)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("vote listener failed", err=repr(e))
 
         if vote.type_ == PREVOTE_TYPE:
             self._check_prevotes(vote)

@@ -1,0 +1,95 @@
+"""Mempool gossip reactor (reference: mempool/reactor.go).
+
+Channel 0x30 (reference: mempool/mempool.go:14 MempoolChannel).  One
+broadcast thread per peer walks the mempool's lanes and sends every tx the
+peer hasn't already sent us (reference: reactor.go:213
+broadcastTxRoutine's send-loop with the senders check).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.mempool.clist_mempool import MempoolError
+from cometbft_tpu.p2p.conn import ChannelDescriptor
+from cometbft_tpu.p2p.reactor import Reactor
+
+MEMPOOL_CHANNEL = 0x30
+_BROADCAST_SLEEP = 0.02
+
+
+class MempoolReactor(Reactor):
+    """Reference: mempool/reactor.go Reactor."""
+
+    def __init__(self, config, mempool, logger=None):
+        super().__init__("MempoolReactor")
+        self.config = config
+        self.mempool = mempool
+        self.logger = logger or liblog.nop_logger()
+        self._peer_routines: dict[str, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                MEMPOOL_CHANNEL,
+                priority=5,
+                send_queue_capacity=100,
+                recv_message_capacity=self.config.max_tx_bytes + 64
+                if hasattr(self.config, "max_tx_bytes")
+                else 1024 * 1024,
+            )
+        ]
+
+    def add_peer(self, peer) -> None:
+        if not getattr(self.config, "broadcast", True):
+            return
+        stop = threading.Event()
+        with self._lock:
+            self._peer_routines[peer.id] = stop
+        threading.Thread(
+            target=self._broadcast_tx_routine,
+            args=(peer, stop),
+            name="mempool-broadcast",
+            daemon=True,
+        ).start()
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._lock:
+            stop = self._peer_routines.pop(peer.id, None)
+        if stop is not None:
+            stop.set()
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        """An incoming tx: CheckTx with the peer recorded as sender."""
+        try:
+            self.mempool.check_tx(msg_bytes, sender=peer.id)
+        except MempoolError:
+            pass  # dupes / full / failed pre-check are non-fatal
+
+    def _broadcast_tx_routine(self, peer, stop: threading.Event) -> None:
+        """Reference: reactor.go:213 broadcastTxRoutine — iterate the lanes
+        forever, skipping txs the peer sent us."""
+        sent: set[bytes] = set()
+        while self.is_running and peer.is_running and not stop.is_set():
+            advanced = False
+            with self.mempool._mtx:
+                entries = [
+                    (el.value.key, el.value.tx, set(el.value.senders))
+                    for el in self.mempool._iter_lane_elems()
+                ]
+            live = set()
+            for key, tx, senders in entries:
+                live.add(key)
+                if key in sent or peer.id in senders:
+                    continue
+                if peer.try_send(MEMPOOL_CHANNEL, tx):
+                    sent.add(key)
+                    advanced = True
+            # drop bookkeeping for txs no longer in the pool
+            if len(sent) > 10000:
+                sent &= live
+            if not advanced:
+                time.sleep(_BROADCAST_SLEEP)

@@ -164,9 +164,87 @@ class Node(BaseService):
             logger=self.logger.with_(module="consensus"),
         )
 
+        # -- p2p switch + reactors (reference: node/node.go:501-538) --------
+        self.switch = None
+        self.addr_book = None
+        if config.p2p.laddr:
+            self._setup_p2p()
+
         # -- RPC ------------------------------------------------------------
         self.rpc_server = None
         self._tx_waiter_thread: Optional[threading.Thread] = None
+
+    def _setup_p2p(self) -> None:
+        """Create transport, switch, and the protocol reactors
+        (reference: node/node.go:501 createTransport → :538 pex)."""
+        from cometbft_tpu.consensus.reactor import ConsensusReactor
+        from cometbft_tpu.evidence.reactor import EvidenceReactor
+        from cometbft_tpu.mempool.clist_mempool import CListMempool
+        from cometbft_tpu.mempool.reactor import MempoolReactor
+        from cometbft_tpu.p2p.node_info import NodeInfo
+        from cometbft_tpu.p2p.pex import AddrBook, PEXReactor
+        from cometbft_tpu.p2p.switch import Switch
+        from cometbft_tpu.p2p.transport import Transport
+
+        config = self.config
+        # channels advertised in the node info (filled below by reactors)
+        self._node_info = NodeInfo(
+            node_id=self.node_key.node_id,
+            network=self.genesis_doc.chain_id,
+            listen_addr=config.p2p.external_address or config.p2p.laddr,
+            moniker=config.base.moniker,
+            rpc_address=config.rpc.laddr,
+        )
+        transport = Transport(
+            self.node_key,
+            lambda: self._node_info,
+            handshake_timeout=config.p2p.handshake_timeout_s,
+            dial_timeout=config.p2p.dial_timeout_s,
+        )
+        self.switch = Switch(
+            config.p2p,
+            transport,
+            lambda: self._node_info,
+            logger=self.logger.with_(module="p2p"),
+        )
+
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus,
+            self.block_store,
+            logger=self.logger.with_(module="consensus-reactor"),
+        )
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        if isinstance(self.mempool, CListMempool):
+            self.mempool_reactor = MempoolReactor(
+                config.mempool,
+                self.mempool,
+                logger=self.logger.with_(module="mempool-reactor"),
+            )
+            self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool, logger=self.logger.with_(module="evidence-reactor")
+        )
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        if config.p2p.pex:
+            book_path = os.path.join(
+                config.base.home, config.p2p.addr_book_file
+            )
+            self.addr_book = AddrBook(book_path, strict=config.p2p.addr_book_strict)
+            self.addr_book.add_our_id(self.node_key.node_id)
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                seeds=config.p2p.seeds,
+                seed_mode=config.p2p.seed_mode,
+                logger=self.logger.with_(module="pex"),
+            )
+            self.switch.add_reactor("PEX", self.pex_reactor)
+            self.switch.addr_book = self.addr_book
+
+        # advertise the union of reactor channels
+        self._node_info.channels = bytes(
+            sorted(self.switch._chan_to_reactor.keys())
+        )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -178,7 +256,19 @@ class Node(BaseService):
             env = Environment(self)
             self.rpc_server = RPCServer(self.config.rpc, env, self.event_bus)
             self.rpc_server.start()
-        self.consensus.start()
+        if self.switch is not None:
+            # listen, then fix up the advertised address with the bound port
+            host, port = self.switch.transport.listen(self.config.p2p.laddr)
+            if not self.config.p2p.external_address:
+                adv_host = "127.0.0.1" if host in ("0.0.0.0", "::") else host
+                self._node_info.listen_addr = f"{adv_host}:{port}"
+            self.switch.start()  # starts reactors; consensus reactor starts cs
+            if self.config.p2p.persistent_peers:
+                self.switch.dial_peers_async(
+                    self.config.p2p.persistent_peers, persistent=True
+                )
+        else:
+            self.consensus.start()
         if self.mempool.txs_available() is not None:
             self._tx_waiter_thread = threading.Thread(
                 target=self._tx_waiter, daemon=True
@@ -201,9 +291,13 @@ class Node(BaseService):
                 self.consensus.notify_txs_available()
 
     def on_stop(self) -> None:
+        if self.switch is not None:
+            self.switch.stop()
         self.consensus.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.addr_book is not None:
+            self.addr_book.save()
         self.proxy_app.stop()
         self.db.close()
         self.logger.info("node stopped")

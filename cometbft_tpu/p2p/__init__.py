@@ -1,0 +1,19 @@
+from cometbft_tpu.p2p.conn import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.node_info import NetAddress, NodeInfo
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.p2p.reactor import Reactor
+from cometbft_tpu.p2p.secret_connection import SecretConnection
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import Transport
+
+__all__ = [
+    "ChannelDescriptor",
+    "MConnection",
+    "NetAddress",
+    "NodeInfo",
+    "Peer",
+    "Reactor",
+    "SecretConnection",
+    "Switch",
+    "Transport",
+]

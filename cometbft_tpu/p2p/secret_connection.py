@@ -1,0 +1,202 @@
+"""Authenticated-encryption transport wrapper — the SecretConnection.
+
+Reference: p2p/conn/secret_connection.go:101 MakeSecretConnection, :354
+deriveSecrets.  Same construction, re-keyed for this framework (wire
+compatibility with CometBFT peers is a non-goal — this is its own network
+protocol):
+
+1. exchange 32-byte ephemeral X25519 public keys in the clear;
+2. ECDH -> HKDF-SHA256 (64-byte output) -> two ChaCha20-Poly1305 keys,
+   ordered by who has the lexically smaller ephemeral key, plus a 32-byte
+   challenge binding both ephemerals;
+3. exchange AEAD-sealed AuthSig{ed25519 pubkey, sig(challenge)} frames and
+   verify — a station-to-station handshake binding the channel to the
+   long-lived node identity (the dialed node ID is the pubkey's address).
+
+Every frame is a fixed-layout AEAD record: 4-byte BE length of the sealed
+payload, then ciphertext.  Nonces are 12-byte little-endian counters, one
+counter per direction; plaintext frames are chunked to at most 1024 bytes
+(reference: dataMaxSize, secret_connection.go:47).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import os
+import socket as _socket
+import struct
+from typing import Optional
+
+from cryptography.exceptions import InvalidSignature, InvalidTag
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey
+from cometbft_tpu.libs import protoenc as pe
+
+DATA_MAX_SIZE = 1024
+_HKDF_INFO = b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+
+
+class SecretConnectionError(Exception):
+    pass
+
+
+def _hkdf_sha256(secret: bytes, info: bytes, length: int) -> bytes:
+    """HKDF (RFC 5869) with SHA-256, empty salt."""
+    prk = _hmac.new(b"\x00" * 32, secret, hashlib.sha256).digest()
+    out, t, i = b"", b"", 1
+    while len(out) < length:
+        t = _hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def derive_secrets(
+    shared: bytes, local_eph: bytes, remote_eph: bytes
+) -> tuple[bytes, bytes, bytes]:
+    """-> (send_key, recv_key, challenge) for this side
+    (reference: secret_connection.go:354 deriveSecrets)."""
+    lo, hi = sorted((local_eph, remote_eph))
+    material = _hkdf_sha256(shared + lo + hi, _HKDF_INFO, 96)
+    key_lo, key_hi, challenge = (
+        material[:32],
+        material[32:64],
+        material[64:96],
+    )
+    if local_eph == lo:
+        return key_lo, key_hi, challenge
+    return key_hi, key_lo, challenge
+
+
+class _HalfDuplex:
+    """One direction of AEAD frames with a counter nonce."""
+
+    def __init__(self, key: bytes):
+        self.aead = ChaCha20Poly1305(key)
+        self.nonce = 0
+
+    def seal(self, plaintext: bytes) -> bytes:
+        n = struct.pack("<Q", self.nonce) + b"\x00\x00\x00\x00"
+        self.nonce += 1
+        return self.aead.encrypt(n, plaintext, None)
+
+    def open(self, ciphertext: bytes) -> bytes:
+        n = struct.pack("<Q", self.nonce) + b"\x00\x00\x00\x00"
+        self.nonce += 1
+        try:
+            return self.aead.decrypt(n, ciphertext, None)
+        except InvalidTag as e:
+            raise SecretConnectionError("AEAD authentication failed") from e
+
+
+class SecretConnection:
+    """Encrypted, authenticated stream over a raw socket-like object.
+
+    ``sock`` needs sendall()/recv().  After the constructor returns, the
+    remote's long-lived Ed25519 key is in ``remote_pub_key``.
+    """
+
+    def __init__(self, sock, priv_key: Ed25519PrivKey):
+        self._sock = sock
+        self._recv_buf = b""
+
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+
+        # 1. exchange ephemerals (plaintext)
+        self._send_raw(eph_pub)
+        remote_eph = self._recv_exact(32)
+
+        if remote_eph == eph_pub:
+            raise SecretConnectionError("remote echoed our ephemeral key")
+
+        # 2. ECDH + key schedule
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        send_key, recv_key, challenge = derive_secrets(
+            shared, eph_pub, remote_eph
+        )
+        self._send = _HalfDuplex(send_key)
+        self._recv = _HalfDuplex(recv_key)
+
+        # 3. authenticate: swap AEAD-sealed {pubkey, sig(challenge)}
+        sig = priv_key.sign(challenge)
+        auth = pe.t_bytes(1, priv_key.pub_key().bytes()) + pe.t_bytes(2, sig)
+        self.write_frame(auth)
+        remote_auth = self.read_frame()
+        f = pe.fields_dict(remote_auth)
+        remote_pub = bytes(f.get(1, [b""])[-1])
+        remote_sig = bytes(f.get(2, [b""])[-1])
+        if len(remote_pub) != 32:
+            raise SecretConnectionError("bad auth pubkey length")
+        pub = Ed25519PubKey(remote_pub)
+        if not pub.verify_signature(challenge, remote_sig):
+            raise SecretConnectionError("challenge signature verification failed")
+        self.remote_pub_key = pub
+
+    # -- framed IO ---------------------------------------------------------
+
+    def write_frame(self, data: bytes) -> None:
+        sealed = self._send.seal(data)
+        self._send_raw(struct.pack(">I", len(sealed)) + sealed)
+
+    def read_frame(self) -> bytes:
+        hdr = self._recv_exact(4)
+        (n,) = struct.unpack(">I", hdr)
+        if n > DATA_MAX_SIZE + 16 + 64:  # data + AEAD tag + slack
+            raise SecretConnectionError(f"oversized frame {n}")
+        return self._recv.open(self._recv_exact(n))
+
+    def write_msg(self, data: bytes) -> None:
+        """Length-prefixed message spanning multiple frames (used for the
+        node-info handshake; MConnection does its own packetization)."""
+        self.write_frame(struct.pack(">I", len(data)))
+        for i in range(0, len(data), DATA_MAX_SIZE):
+            self.write_frame(data[i : i + DATA_MAX_SIZE])
+
+    def read_msg(self, max_size: int = 1 << 20) -> bytes:
+        hdr = self.read_frame()
+        if len(hdr) != 4:
+            raise SecretConnectionError("bad message header")
+        (n,) = struct.unpack(">I", hdr)
+        if n > max_size:
+            raise SecretConnectionError(f"message too large: {n}")
+        out = b""
+        while len(out) < n:
+            out += self.read_frame()
+        if len(out) != n:
+            raise SecretConnectionError("message length mismatch")
+        return out
+
+    # -- raw socket helpers ------------------------------------------------
+
+    def _send_raw(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = self._recv_buf
+        while len(buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise SecretConnectionError("connection closed")
+            buf += chunk
+        self._recv_buf = buf[n:]
+        return buf[:n]
+
+    def close(self) -> None:
+        # shutdown() first: close() alone does not send FIN while another
+        # thread is blocked in recv() on the same fd (the in-flight recv
+        # keeps the file description alive), so the peer would never see EOF
+        try:
+            self._sock.shutdown(_socket.SHUT_RDWR)
+        except (OSError, AttributeError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

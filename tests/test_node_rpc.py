@@ -41,6 +41,7 @@ def node(tmp_path):
     cfg.base.home = home
     cfg.base.db_backend = "memdb"
     cfg.rpc.laddr = "tcp://127.0.0.1:0"  # ephemeral port
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"  # ephemeral p2p port (no peers)
     cfg.consensus.timeout_commit_ms = 50
     cfg.consensus.timeout_propose_ms = 2000
     n = Node(cfg)
@@ -154,6 +155,7 @@ def test_restart_replays_state(tmp_path):
     cfg.base.home = home
     cfg.base.db_backend = "sqlite"
     cfg.rpc.laddr = ""
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
     cfg.consensus.timeout_commit_ms = 50
     n = Node(cfg)
     n.start()

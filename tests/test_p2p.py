@@ -1,0 +1,380 @@
+"""P2P stack tests (reference test model: p2p/conn/secret_connection_test.go,
+p2p/conn/connection_test.go, p2p/switch_test.go, p2p/pex/pex_reactor_test.go).
+"""
+
+import hashlib
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.config.config import P2PConfig
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.node.nodekey import NodeKey
+from cometbft_tpu.p2p.conn import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.node_info import NetAddress, NodeInfo
+from cometbft_tpu.p2p.pex import AddrBook, PEXReactor, PEX_CHANNEL
+from cometbft_tpu.p2p.reactor import Reactor
+from cometbft_tpu.p2p.secret_connection import (
+    SecretConnection,
+    SecretConnectionError,
+)
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.p2p.transport import Transport
+
+NETWORK = "p2p-test-chain"
+
+
+def _priv(tag: str) -> Ed25519PrivKey:
+    return Ed25519PrivKey.from_seed(hashlib.sha256(tag.encode()).digest())
+
+
+def _socket_pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def _make_secret_pair(priv1=None, priv2=None):
+    priv1 = priv1 or _priv("sc1")
+    priv2 = priv2 or _priv("sc2")
+    s1, s2 = _socket_pair()
+    out = {}
+
+    def server():
+        out["sc2"] = SecretConnection(s2, priv2)
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    sc1 = SecretConnection(s1, priv1)
+    t.join(timeout=5)
+    return sc1, out["sc2"], priv1, priv2
+
+
+class TestSecretConnection:
+    def test_handshake_and_roundtrip(self):
+        sc1, sc2, priv1, priv2 = _make_secret_pair()
+        assert sc1.remote_pub_key.bytes() == priv2.pub_key().bytes()
+        assert sc2.remote_pub_key.bytes() == priv1.pub_key().bytes()
+        sc1.write_frame(b"hello")
+        assert sc2.read_frame() == b"hello"
+        sc2.write_frame(b"world")
+        assert sc1.read_frame() == b"world"
+        # multi-frame messages
+        big = bytes(range(256)) * 20  # 5120 bytes
+        sc1.write_msg(big)
+        assert sc2.read_msg() == big
+
+    def test_tampered_ciphertext_rejected(self):
+        s1, s2 = _socket_pair()
+        priv1, priv2 = _priv("t1"), _priv("t2")
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(sc=SecretConnection(s2, priv2)),
+            daemon=True,
+        )
+        t.start()
+        sc1 = SecretConnection(s1, priv1)
+        t.join(timeout=5)
+        sc2 = out["sc"]
+        # intercept: flip one ciphertext bit
+        import struct as _struct
+
+        sealed = sc1._send.seal(b"attack at dawn")
+        corrupted = bytes([sealed[0] ^ 1]) + sealed[1:]
+        s1.sendall(_struct.pack(">I", len(corrupted)) + corrupted)
+        with pytest.raises(SecretConnectionError):
+            sc2.read_frame()
+
+    def test_nonce_advances(self):
+        sc1, sc2, _, _ = _make_secret_pair()
+        sc1.write_frame(b"a")
+        sc1.write_frame(b"b")
+        assert sc2.read_frame() == b"a"
+        assert sc2.read_frame() == b"b"
+        assert sc1._send.nonce == sc2._recv.nonce
+
+
+def _mconn_pair(descs1, descs2=None, **kw):
+    sc1, sc2, _, _ = _make_secret_pair()
+    recv1, recv2 = [], []
+    err1, err2 = [], []
+    m1 = MConnection(
+        sc1, descs1, lambda c, m: recv1.append((c, m)), err1.append, **kw
+    )
+    m2 = MConnection(
+        sc2,
+        descs2 or descs1,
+        lambda c, m: recv2.append((c, m)),
+        err2.append,
+        **kw,
+    )
+    m1.start()
+    m2.start()
+    return m1, m2, recv1, recv2, err1, err2
+
+
+def _wait_for(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestMConnection:
+    def test_multiplexed_messages(self):
+        descs = [
+            ChannelDescriptor(id=0x20, priority=5, send_queue_capacity=20),
+            ChannelDescriptor(id=0x30, priority=1, send_queue_capacity=20),
+        ]
+        m1, m2, recv1, recv2, err1, err2 = _mconn_pair(descs)
+        try:
+            assert m1.send(0x20, b"consensus-msg")
+            assert m1.send(0x30, b"mempool-msg")
+            big = b"x" * 5000  # forces multi-packet
+            assert m1.send(0x20, big)
+            assert _wait_for(lambda: len(recv2) == 3)
+            got = dict(((c, m) if m != big else (c, "BIG")) for c, m in recv2)
+            assert (0x20, b"consensus-msg") in recv2
+            assert (0x30, b"mempool-msg") in recv2
+            assert (0x20, big) in recv2
+            # reverse direction
+            assert m2.send(0x30, b"reply")
+            assert _wait_for(lambda: (0x30, b"reply") in recv1)
+            assert not err1 and not err2
+        finally:
+            m1.stop()
+            m2.stop()
+
+    def test_unknown_channel_errors_peer(self):
+        m1, m2, recv1, recv2, err1, err2 = _mconn_pair(
+            [ChannelDescriptor(id=0x20)],
+            [ChannelDescriptor(id=0x21)],  # mismatched channels
+        )
+        try:
+            m1.send(0x20, b"msg")
+            assert _wait_for(lambda: len(err2) == 1)
+        finally:
+            m1.stop()
+            m2.stop()
+
+
+class EchoReactor(Reactor):
+    """Echoes every message back on the same channel."""
+
+    CHANNEL = 0x77
+
+    def __init__(self, tag: str):
+        super().__init__(f"Echo-{tag}")
+        self.received = []
+        self.peers = []
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=self.CHANNEL, priority=1, send_queue_capacity=10
+            )
+        ]
+
+    def add_peer(self, peer):
+        self.peers.append(peer)
+
+    def remove_peer(self, peer, reason):
+        if peer in self.peers:
+            self.peers.remove(peer)
+
+    def receive(self, chan_id, peer, msg_bytes):
+        self.received.append(msg_bytes)
+        if not msg_bytes.startswith(b"echo:"):
+            peer.try_send(chan_id, b"echo:" + msg_bytes)
+
+
+def _make_switch(tag: str, port: int = 0, **cfg_overrides):
+    nk = NodeKey(_priv(f"switch-{tag}"))
+    cfg = P2PConfig(laddr=f"tcp://127.0.0.1:{port}", allow_duplicate_ip=True)
+    for k, v in cfg_overrides.items():
+        setattr(cfg, k, v)
+    info_holder = {}
+
+    def node_info_fn():
+        return info_holder["info"]
+
+    tr = Transport(nk, node_info_fn, handshake_timeout=5.0, dial_timeout=2.0)
+    addr = tr.listen(cfg.laddr)
+    sw = Switch(cfg, tr, node_info_fn)
+    echo = EchoReactor(tag)
+    sw.add_reactor("echo", echo)
+    info_holder["info"] = NodeInfo(
+        node_id=nk.node_id,
+        network=NETWORK,
+        listen_addr=f"127.0.0.1:{addr[1]}",
+        channels=bytes([EchoReactor.CHANNEL, PEX_CHANNEL]),
+        moniker=tag,
+    )
+    return sw, echo, nk, addr
+
+
+class TestSwitch:
+    def test_two_switches_connect_and_echo(self):
+        sw1, echo1, nk1, addr1 = _make_switch("a")
+        sw2, echo2, nk2, addr2 = _make_switch("b")
+        sw1.start()
+        sw2.start()
+        try:
+            ok = sw1.dial_peer(
+                NetAddress(nk2.node_id, "127.0.0.1", addr2[1])
+            )
+            assert ok
+            assert _wait_for(lambda: len(echo2.peers) == 1)
+            assert _wait_for(lambda: len(echo1.peers) == 1)
+
+            # send over reactor channel, expect echo back
+            peer = echo1.peers[0]
+            peer.send(EchoReactor.CHANNEL, b"ping-from-a")
+            assert _wait_for(lambda: b"ping-from-a" in echo2.received)
+            assert _wait_for(lambda: b"echo:ping-from-a" in echo1.received)
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_wrong_network_rejected(self):
+        sw1, echo1, nk1, addr1 = _make_switch("na")
+        nk3 = NodeKey(_priv("switch-other"))
+
+        def other_info():
+            return NodeInfo(
+                node_id=nk3.node_id,
+                network="other-chain",
+                channels=bytes([EchoReactor.CHANNEL]),
+            )
+
+        tr3 = Transport(nk3, other_info, handshake_timeout=5.0)
+        sw1.start()
+        try:
+            from cometbft_tpu.p2p.transport import TransportError
+
+            with pytest.raises(TransportError):
+                tr3.dial(NetAddress(nk1.node_id, "127.0.0.1", addr1[1]))
+        finally:
+            sw1.stop()
+
+    def test_wrong_id_rejected(self):
+        sw1, _, nk1, addr1 = _make_switch("ida")
+        sw2, _, nk2, addr2 = _make_switch("idb")
+        sw1.start()
+        try:
+            fake_id = "ab" * 20
+            ok = sw2.dial_peer(NetAddress(fake_id, "127.0.0.1", addr1[1]))
+            assert not ok
+        finally:
+            sw1.stop()
+            sw2.transport.close()
+
+    def test_peer_disconnect_notifies_reactors(self):
+        sw1, echo1, nk1, addr1 = _make_switch("da")
+        sw2, echo2, nk2, addr2 = _make_switch("db")
+        sw1.start()
+        sw2.start()
+        try:
+            sw1.dial_peer(NetAddress(nk2.node_id, "127.0.0.1", addr2[1]))
+            assert _wait_for(lambda: len(echo1.peers) == 1)
+            assert _wait_for(lambda: len(echo2.peers) == 1)
+            sw2.stop()  # closes its side
+            assert _wait_for(lambda: len(echo1.peers) == 0, timeout=10)
+        finally:
+            sw1.stop()
+
+
+class TestAddrBook:
+    def test_add_pick_persist(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path)
+        id1, id2 = "11" * 20, "22" * 20
+        assert book.add_address(NetAddress.parse(f"{id1}@10.0.0.1:26656"))
+        assert book.add_address(NetAddress.parse(f"{id2}@10.0.0.2:26656"))
+        assert not book.add_address(
+            NetAddress.parse(f"{id1}@10.0.0.1:26656")
+        )  # dupe
+        assert book.size() == 2
+
+        na = book.pick_address(exclude={id1})
+        assert na.id == id2
+
+        book.mark_good(NetAddress.parse(f"{id1}@10.0.0.1:26656"))
+        book.save()
+        book2 = AddrBook(path)
+        assert book2.size() == 2
+        with book2._lock:
+            assert book2._addrs[id1].bucket == "old"
+
+    def test_unreachable_new_addr_dropped(self):
+        book = AddrBook()
+        na = NetAddress.parse(f"{'33'*20}@10.0.0.3:26656")
+        book.add_address(na)
+        for _ in range(AddrBook.MAX_ATTEMPTS):
+            book.mark_attempt(na)
+        assert book.size() == 0
+
+
+class TestPEX:
+    def test_addr_exchange(self):
+        # three nodes: A knows B; C connects to B and learns A via PEX
+        sws = []
+        try:
+            made = [_make_switch(f"pex{i}") for i in range(3)]
+            books = []
+            for i, (sw, echo, nk, addr) in enumerate(made):
+                book = AddrBook()
+                book.add_our_id(nk.node_id)
+                pex = PEXReactor(book)
+                sw.add_reactor("pex", pex)
+                sw.addr_book = book
+                books.append(book)
+                sw.start()
+                sws.append(sw)
+            (swA, _, nkA, addrA), (swB, _, nkB, addrB), (swC, _, nkC, addrC) = made
+            assert swA.dial_peer(NetAddress(nkB.node_id, "127.0.0.1", addrB[1]))
+            assert _wait_for(lambda: len(swB.peers_list()) == 1, timeout=5)
+            assert swC.dial_peer(NetAddress(nkB.node_id, "127.0.0.1", addrB[1]))
+
+            # C requested addrs from B on connect; B learned A's dial addr
+            def c_knows_a():
+                with books[2]._lock:
+                    return nkA.node_id in books[2]._addrs
+
+            assert _wait_for(c_knows_a, timeout=10)
+        finally:
+            for sw in sws:
+                sw.stop()
+
+
+class TestFuzzedConnection:
+    def test_drop_mode_eventually_breaks_frames(self):
+        from cometbft_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+        import random as _random
+
+        s1, s2 = _socket_pair()
+        # drop every write after the handshake finishes
+        cfg = FuzzConnConfig(mode="drop", prob_drop_rw=0.0)
+        fz = FuzzedConnection(s1, cfg, rng=_random.Random(42))
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(sc=SecretConnection(s2, _priv("fz2"))),
+            daemon=True,
+        )
+        t.start()
+        sc1 = SecretConnection(fz, _priv("fz1"))
+        t.join(timeout=5)
+        sc2 = out["sc"]
+        # sanity: frames flow with prob 0
+        sc1.write_frame(b"ok")
+        assert sc2.read_frame() == b"ok"
+        # now drop everything: receiver sees nothing (would block), so just
+        # verify the write is swallowed without error
+        cfg.prob_drop_rw = 1.0
+        sc1.write_frame(b"lost")
+        s2.settimeout(0.3)
+        with pytest.raises((socket.timeout, TimeoutError, SecretConnectionError)):
+            sc2.read_frame()

@@ -1,0 +1,196 @@
+"""Multi-node integration over real TCP sockets: consensus gossip,
+mempool gossip, evidence gossip (reference test model:
+internal/consensus/reactor_test.go, mempool/reactor_test.go,
+internal/evidence/reactor_test.go, node/node_test.go).
+"""
+
+import hashlib
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.config.config import Config
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.types.basic import Timestamp
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN_ID = "reactor-test-chain"
+N_VALS = 3
+
+
+def _wait_for(cond, timeout=30.0, tick=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def _make_node_home(tmp_path, i: int, gdoc: GenesisDoc, priv) -> Config:
+    home = str(tmp_path / f"node{i}")
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    with open(os.path.join(home, "config", "genesis.json"), "w") as f:
+        f.write(gdoc.to_json())
+    pv = FilePV(
+        priv,
+        os.path.join(home, "config", "priv_validator_key.json"),
+        os.path.join(home, "data", "priv_validator_state.json"),
+    )
+    pv.save()
+
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = f"node{i}"
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = ""  # no RPC in these tests
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"  # auto-assign port
+    cfg.p2p.allow_duplicate_ip = True
+    cfg.consensus.timeout_propose_ms = 2000
+    cfg.consensus.timeout_propose_delta_ms = 500
+    cfg.consensus.timeout_vote_ms = 1000
+    cfg.consensus.timeout_vote_delta_ms = 500
+    cfg.consensus.timeout_commit_ms = 100
+    cfg.mempool.recheck = False
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("reactor-net")
+    privs = [
+        Ed25519PrivKey.from_seed(hashlib.sha256(b"reactval%d" % i).digest())
+        for i in range(N_VALS)
+    ]
+    gdoc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=Timestamp(0, 0),
+        validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+    )
+    nodes = []
+    try:
+        # start node 0 first to learn its address
+        cfg0 = _make_node_home(tmp_path, 0, gdoc, privs[0])
+        n0 = Node(cfg0)
+        n0.start()
+        nodes.append(n0)
+        addr0 = n0.switch.transport.listen_addr
+        peer0 = f"{n0.node_key.node_id}@127.0.0.1:{addr0[1]}"
+
+        for i in range(1, N_VALS):
+            cfg = _make_node_home(tmp_path, i, gdoc, privs[i])
+            cfg.p2p.persistent_peers = [peer0]
+            n = Node(cfg)
+            n.start()
+            nodes.append(n)
+        yield nodes
+    finally:
+        for n in nodes:
+            try:
+                n.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class TestConsensusGossip:
+    def test_all_nodes_make_blocks(self, net):
+        assert _wait_for(
+            lambda: all(n.consensus.height >= 3 for n in net), timeout=60
+        ), f"heights: {[n.consensus.height for n in net]}"
+
+    def test_peers_connected(self, net):
+        # node1 and node2 discover each other through PEX via node0
+        counts = [len(n.switch.peers_list()) for n in net]
+        assert counts[0] >= 2
+        assert all(c >= 1 for c in counts)
+
+
+class TestMempoolGossip:
+    def test_tx_submitted_on_one_node_commits_everywhere(self, net):
+        tx = b"gossip-key=gossip-value"
+        net[1].mempool.check_tx(tx)
+
+        def committed_on(n):
+            h = n.block_store.height()
+            for height in range(max(n.block_store.base(), 1), h + 1):
+                block = n.block_store.load_block(height)
+                if block is not None and tx in block.data.txs:
+                    return True
+            return False
+
+        assert _wait_for(
+            lambda: all(committed_on(n) for n in net), timeout=60
+        ), "tx did not commit on all nodes"
+
+
+class TestEvidenceGossip:
+    def test_evidence_gossips_and_commits(self, net):
+        from cometbft_tpu.types.basic import (
+            PRECOMMIT_TYPE,
+            BlockID,
+            PartSetHeader,
+        )
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.vote import Vote
+
+        # wait for some committed height so the evidence is verifiable
+        assert _wait_for(lambda: net[0].consensus.height >= 2, timeout=60)
+
+        byz_priv = Ed25519PrivKey.from_seed(
+            hashlib.sha256(b"reactval0").digest()
+        )
+        addr = byz_priv.pub_key().address()
+        state = net[1].consensus.state
+        vals = net[1].state_store.load_validators(1)
+        idx, val = vals.get_by_address(addr)
+        meta = net[1].block_store.load_block_meta(1)
+
+        def mkvote(tag: bytes) -> Vote:
+            v = Vote(
+                type_=PRECOMMIT_TYPE,
+                height=1,
+                round_=0,
+                block_id=BlockID(
+                    hash=hashlib.sha256(tag).digest(),
+                    part_set_header=PartSetHeader(
+                        1, hashlib.sha256(tag + b"p").digest()
+                    ),
+                ),
+                timestamp=meta.header.time,
+                validator_address=addr,
+                validator_index=idx,
+            )
+            v.signature = byz_priv.sign(v.sign_bytes(CHAIN_ID))
+            return v
+
+        ev = DuplicateVoteEvidence.from_votes(
+            mkvote(b"fork-a"),
+            mkvote(b"fork-b"),
+            meta.header.time,
+            val.voting_power,
+            vals.total_voting_power(),
+        )
+        net[1].evidence_pool.add_evidence(ev)
+
+        # the evidence should gossip to other pools and land in a block
+        def pool_has(n):
+            return any(
+                e.hash() == ev.hash() for e in n.evidence_pool.all_pending()
+            ) or n.evidence_pool._is_committed(ev)
+
+        assert _wait_for(lambda: all(pool_has(n) for n in net), timeout=30)
+
+        def committed_in_block(n):
+            for height in range(1, n.block_store.height() + 1):
+                block = n.block_store.load_block(height)
+                if block and any(e.hash() == ev.hash() for e in block.evidence):
+                    return True
+            return False
+
+        assert _wait_for(
+            lambda: all(committed_in_block(n) for n in net), timeout=60
+        ), "evidence did not commit on all nodes"
