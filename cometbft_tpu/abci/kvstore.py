@@ -24,6 +24,8 @@ from cometbft_tpu.abci.application import BaseApplication
 
 VALIDATOR_PREFIX = b"val:"
 SNAPSHOT_CHUNK_SIZE = 65536
+SNAPSHOT_INTERVAL = 5  # snapshot every K heights (reference: snapshot_interval)
+SNAPSHOT_KEEP = 10
 APP_VERSION = 1
 
 
@@ -205,10 +207,10 @@ class KVStoreApplication(BaseApplication):
         )
 
     def commit(self, req):
-        self._snapshots[self.height] = self._serialize()
-        # keep only the 4 most recent snapshots
-        for h in sorted(self._snapshots)[:-4]:
-            del self._snapshots[h]
+        if self.height % SNAPSHOT_INTERVAL == 0:
+            self._snapshots[self.height] = self._serialize()
+            for h in sorted(self._snapshots)[:-SNAPSHOT_KEEP]:
+                del self._snapshots[h]
         retain = 0
         if self.retain_blocks and self.height > self.retain_blocks:
             retain = self.height - self.retain_blocks

@@ -1,0 +1,203 @@
+"""Blocksync block pool: parallel block download from many peers.
+
+Reference: internal/blocksync/pool.go:72 BlockPool — a sliding window of
+in-flight height requests assigned to peers that advertise the height,
+with per-request timeouts, peer banning on bad blocks, and a two-block
+verification frontier (``peek_two_blocks``): block H is verified with the
+LastCommit carried by block H+1.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from cometbft_tpu.libs import log as liblog
+
+REQUEST_WINDOW = 40  # max heights in flight (reference: maxPendingRequests=600, scaled down)
+REQUEST_TIMEOUT = 15.0  # reassign a request after this long
+MIN_RECV_RATE = 0  # bytes/sec floor (reference: minRecvRate, disabled here)
+
+
+@dataclass
+class _PeerData:
+    peer_id: str
+    base: int = 0
+    height: int = 0  # highest block the peer claims
+    num_pending: int = 0
+    banned_until: float = 0.0
+
+
+@dataclass
+class _Request:
+    height: int
+    peer_id: str
+    sent_at: float
+    block: Optional[object] = None  # types.Block once received
+
+
+class BlockPool:
+    """Reference: pool.go BlockPool."""
+
+    def __init__(
+        self,
+        start_height: int,
+        send_request: Callable[[str, int], bool],
+        logger: Optional[liblog.Logger] = None,
+    ):
+        self.height = start_height  # next height to pop
+        self.send_request = send_request
+        self.logger = logger or liblog.nop_logger()
+        self._lock = threading.RLock()
+        self.peers: dict[str, _PeerData] = {}
+        self.requests: dict[int, _Request] = {}
+        self.ever_had_peers = False
+        self._started_at = time.monotonic()
+        self._last_advance = time.monotonic()
+
+    # -- peers -------------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """Reference: pool.go SetPeerRange (from StatusResponse)."""
+        with self._lock:
+            pd = self.peers.get(peer_id)
+            if pd is None:
+                pd = _PeerData(peer_id)
+                self.peers[peer_id] = pd
+            self.ever_had_peers = True
+            pd.base = base
+            pd.height = max(pd.height, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._lock:
+            self.peers.pop(peer_id, None)
+            for h, req in list(self.requests.items()):
+                if req.peer_id == peer_id and req.block is None:
+                    del self.requests[h]  # will be re-requested
+
+    def ban_peer(self, peer_id: str, duration: float = 60.0) -> None:
+        """Reference: peer banning on bad blocks / timeouts
+        (pool.go:153,431)."""
+        with self._lock:
+            pd = self.peers.get(peer_id)
+            if pd is not None:
+                pd.banned_until = time.monotonic() + duration
+
+    def max_peer_height(self) -> int:
+        with self._lock:
+            return max((p.height for p in self.peers.values()), default=0)
+
+    # -- blocks ------------------------------------------------------------
+
+    def add_block(self, peer_id: str, block) -> bool:
+        """Reference: pool.go:296 AddBlock — only accepted if this peer owns
+        the outstanding request for that height."""
+        height = block.header.height
+        with self._lock:
+            req = self.requests.get(height)
+            if req is None or req.peer_id != peer_id or req.block is not None:
+                return False
+            req.block = block
+            pd = self.peers.get(peer_id)
+            if pd is not None:
+                pd.num_pending = max(pd.num_pending - 1, 0)
+            return True
+
+    def no_block(self, peer_id: str, height: int) -> None:
+        """Peer explicitly has no such block: re-request elsewhere."""
+        with self._lock:
+            req = self.requests.get(height)
+            if req is not None and req.peer_id == peer_id and req.block is None:
+                del self.requests[height]
+                pd = self.peers.get(peer_id)
+                if pd is not None:
+                    pd.num_pending = max(pd.num_pending - 1, 0)
+
+    def peek_two_blocks(self):
+        """Reference: pool.go:218 PeekTwoBlocks — (first, second) or Nones."""
+        with self._lock:
+            first = self.requests.get(self.height)
+            second = self.requests.get(self.height + 1)
+            return (
+                first.block if first else None,
+                second.block if second else None,
+                first.peer_id if first else "",
+                second.peer_id if second else "",
+            )
+
+    def pop_request(self) -> None:
+        """First block verified + applied: advance the frontier."""
+        with self._lock:
+            self.requests.pop(self.height, None)
+            self.height += 1
+            self._last_advance = time.monotonic()
+
+    def redo_request(self, height: int) -> str:
+        """Bad block at ``height``: drop the block, ban the sender
+        (reference: pool.go RedoRequest)."""
+        with self._lock:
+            req = self.requests.pop(height, None)
+            if req is None:
+                return ""
+            self.ban_peer(req.peer_id)
+            return req.peer_id
+
+    # -- request scheduling ------------------------------------------------
+
+    def make_next_requests(self) -> None:
+        """Fill the sliding window [height, height+WINDOW) with requests
+        (reference: makeRequestersRoutine, pool.go:116)."""
+        now = time.monotonic()
+        with self._lock:
+            max_h = self.max_peer_height()
+            wanted = [
+                h
+                for h in range(self.height, min(self.height + REQUEST_WINDOW, max_h + 1))
+                if h not in self.requests
+            ]
+            # expire timed-out requests
+            for h, req in list(self.requests.items()):
+                if req.block is None and now - req.sent_at > REQUEST_TIMEOUT:
+                    self.ban_peer(req.peer_id, 30.0)
+                    pd = self.peers.get(req.peer_id)
+                    if pd is not None:
+                        pd.num_pending = max(pd.num_pending - 1, 0)
+                    del self.requests[h]
+                    if h not in wanted:
+                        wanted.append(h)
+            candidates = [
+                p
+                for p in self.peers.values()
+                if p.banned_until < now
+            ]
+            for h in sorted(wanted):
+                peers = [
+                    p
+                    for p in candidates
+                    if p.base <= h <= p.height and p.num_pending < 20
+                ]
+                if not peers:
+                    continue
+                pd = random.choice(peers)
+                self.requests[h] = _Request(h, pd.peer_id, now)
+                pd.num_pending += 1
+                # send outside the lock would be nicer; try_send never blocks
+                if not self.send_request(pd.peer_id, h):
+                    del self.requests[h]
+                    pd.num_pending -= 1
+
+    # -- progress ----------------------------------------------------------
+
+    def is_caught_up(self) -> bool:
+        """Reference: pool.go IsCaughtUp — at (or past) the best peer
+        height, with at least one peer heard from."""
+        with self._lock:
+            if not self.peers:
+                return False
+            return self.height >= self.max_peer_height()
+
+    def stalled_for(self) -> float:
+        return time.monotonic() - self._last_advance

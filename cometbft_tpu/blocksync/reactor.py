@@ -1,0 +1,255 @@
+"""Blocksync reactor (reference: internal/blocksync/reactor.go).
+
+Channel 0x40 (reference: reactor.go:20).  Serves stored blocks to
+catching-up peers and drives the BlockPool: status exchange, parallel
+block download, then the two-block verification pipeline —
+``verify_commit_light`` on block H using block H+1's LastCommit routes
+through the batch-verifier seam (the TPU path), making catchup the
+biggest batch-verification consumer in the system (SURVEY.md §2.2).
+On completion it hands off to consensus (SwitchToConsensus).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.p2p.conn import ChannelDescriptor
+from cometbft_tpu.p2p.reactor import Reactor
+from cometbft_tpu.types import codec, validation
+from cometbft_tpu.types.basic import BlockID
+
+BLOCKSYNC_CHANNEL = 0x40
+
+_MSG_BLOCK_REQUEST = 1
+_MSG_BLOCK_RESPONSE = 2
+_MSG_NO_BLOCK_RESPONSE = 3
+_MSG_STATUS_REQUEST = 4
+_MSG_STATUS_RESPONSE = 5
+
+_STATUS_INTERVAL = 5.0
+_SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+_POOL_TICK = 0.02
+
+
+def _enc(kind: int, body: bytes = b"") -> bytes:
+    return bytes([kind]) + body
+
+
+class BlocksyncReactor(Reactor):
+    """Reference: internal/blocksync/reactor.go Reactor."""
+
+    def __init__(
+        self,
+        state,  # sm.State at boot
+        block_exec,
+        block_store,
+        consensus_reactor=None,  # for SwitchToConsensus
+        enabled: bool = True,
+        logger=None,
+    ):
+        super().__init__("BlocksyncReactor")
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.consensus_reactor = consensus_reactor
+        self.logger = logger or liblog.nop_logger()
+        self.syncing = enabled
+        start = max(block_store.height() + 1, state.initial_height)
+        self.pool = BlockPool(start, self._send_block_request, self.logger)
+        self._thread: Optional[threading.Thread] = None
+        self.synced_at: Optional[float] = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                BLOCKSYNC_CHANNEL,
+                priority=5,
+                send_queue_capacity=1000,
+                recv_message_capacity=64 * 1024 * 1024,
+            )
+        ]
+
+    def on_start(self) -> None:
+        if self.syncing:
+            self._start_pool()
+
+    def _start_pool(self) -> None:
+        self._thread = threading.Thread(
+            target=self._pool_routine, name="blocksync-pool", daemon=True
+        )
+        self._thread.start()
+
+    def start_sync(self, state) -> None:
+        """Hand-off from statesync (reference: bcReactor.SwitchToBlockSync,
+        node/setup.go:587-601): resume block download from the snapshot
+        height."""
+        self.state = state
+        self.pool.height = max(
+            self.block_store.height() + 1, state.last_block_height + 1
+        )
+        self.pool._started_at = time.monotonic()
+        self.syncing = True
+        self._start_pool()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        # announce our range + ask for theirs
+        peer.try_send(BLOCKSYNC_CHANNEL, self._status_response())
+        peer.try_send(BLOCKSYNC_CHANNEL, _enc(_MSG_STATUS_REQUEST))
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    def _status_response(self) -> bytes:
+        body = pe.t_varint(1, self.block_store.height()) + pe.t_varint(
+            2, self.block_store.base()
+        )
+        return _enc(_MSG_STATUS_RESPONSE, body)
+
+    def _send_block_request(self, peer_id: str, height: int) -> bool:
+        sw = self.switch
+        if sw is None:
+            return False
+        peer = sw.get_peer(peer_id)
+        if peer is None:
+            return False
+        return peer.try_send(
+            BLOCKSYNC_CHANNEL, _enc(_MSG_BLOCK_REQUEST, pe.t_varint(1, height))
+        )
+
+    # -- receive -----------------------------------------------------------
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        kind, body = msg_bytes[0], msg_bytes[1:]
+        if kind == _MSG_BLOCK_REQUEST:
+            f = pe.fields_dict(body)
+            height = pe.to_int64(f.get(1, [0])[-1])
+            block = self.block_store.load_block(height)
+            if block is not None:
+                peer.try_send(
+                    BLOCKSYNC_CHANNEL,
+                    _enc(
+                        _MSG_BLOCK_RESPONSE,
+                        pe.t_message(1, codec.encode_block(block), always=True),
+                    ),
+                )
+            else:
+                peer.try_send(
+                    BLOCKSYNC_CHANNEL,
+                    _enc(_MSG_NO_BLOCK_RESPONSE, pe.t_varint(1, height)),
+                )
+        elif kind == _MSG_BLOCK_RESPONSE:
+            f = pe.fields_dict(body)
+            block = codec.decode_block(f[1][-1])
+            self.pool.add_block(peer.id, block)
+        elif kind == _MSG_NO_BLOCK_RESPONSE:
+            f = pe.fields_dict(body)
+            self.pool.no_block(peer.id, pe.to_int64(f.get(1, [0])[-1]))
+        elif kind == _MSG_STATUS_REQUEST:
+            peer.try_send(BLOCKSYNC_CHANNEL, self._status_response())
+        elif kind == _MSG_STATUS_RESPONSE:
+            f = pe.fields_dict(body)
+            height = pe.to_int64(f.get(1, [0])[-1])
+            base = pe.to_int64(f.get(2, [0])[-1])
+            self.pool.set_peer_range(peer.id, base, height)
+
+    # -- the sync loop (reference: reactor.go poolRoutine) -----------------
+
+    def _pool_routine(self) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        while self.is_running and self.syncing:
+            try:
+                now = time.monotonic()
+                if now - last_status > _STATUS_INTERVAL:
+                    last_status = now
+                    if self.switch is not None:
+                        self.switch.broadcast(
+                            BLOCKSYNC_CHANNEL, _enc(_MSG_STATUS_REQUEST)
+                        )
+                if now - last_switch_check > _SWITCH_TO_CONSENSUS_INTERVAL:
+                    last_switch_check = now
+                    if self._maybe_switch_to_consensus():
+                        return
+                self.pool.make_next_requests()
+                if not self._process_blocks():
+                    time.sleep(_POOL_TICK)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("blocksync pool error", err=repr(e))
+                time.sleep(0.5)
+
+    def _process_blocks(self) -> bool:
+        """Verify + apply the frontier block using the NEXT block's
+        LastCommit (reference: reactor.go:541)."""
+        first, second, first_peer, second_peer = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return False
+        first_parts = first.make_part_set()
+        first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
+        try:
+            # THE verification: batch Ed25519 through the pluggable seam
+            validation.verify_commit_light(
+                self.state.chain_id,
+                self.state.validators,
+                first_id,
+                first.header.height,
+                second.last_commit,
+            )
+        except validation.CommitVerificationError as e:
+            self.logger.error(
+                "invalid block in blocksync",
+                height=first.header.height,
+                err=str(e),
+            )
+            # ban both providers and retry (reference: reactor.go bad-block path)
+            self.pool.redo_request(first.header.height)
+            self.pool.redo_request(first.header.height + 1)
+            for pid in (first_peer, second_peer):
+                if self.switch is not None and pid:
+                    p = self.switch.get_peer(pid)
+                    if p is not None:
+                        self.switch.stop_peer_for_error(p, e)
+            return True
+        self.block_store.save_block(first, first_parts, second.last_commit)
+        self.state = self.block_exec.apply_verified_block(
+            self.state, first_id, first
+        )
+        self.pool.pop_request()
+        if self.block_store.height() % 100 == 0:
+            self.logger.info(
+                "blocksync progress",
+                height=self.block_store.height(),
+                target=self.pool.max_peer_height(),
+            )
+        return True
+
+    def _maybe_switch_to_consensus(self) -> bool:
+        """Reference: poolRoutine's switchToConsensusTicker."""
+        if not self.pool.is_caught_up():
+            # never heard from any peer after a grace period: we are alone
+            # (solo chain / isolated) — run consensus.  A TEMPORARILY empty
+            # peer set mid-sync must NOT trigger this: reconnect will refill
+            if (
+                not self.pool.ever_had_peers
+                and time.monotonic() - self.pool._started_at > 10.0
+            ):
+                return self._switch()
+            return False
+        return self._switch()
+
+    def _switch(self) -> bool:
+        self.syncing = False
+        self.synced_at = time.monotonic()
+        self.logger.info(
+            "blocksync complete, switching to consensus",
+            height=self.block_store.height(),
+        )
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(self.state)
+        return True

@@ -1,0 +1,23 @@
+from cometbft_tpu.light.client import SEQUENTIAL, SKIPPING, LightClient
+from cometbft_tpu.light.provider import HTTPProvider, NodeProvider, Provider
+from cometbft_tpu.light.store import LightStore
+from cometbft_tpu.light.verifier import (
+    TrustOptions,
+    verify,
+    verify_adjacent,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "LightClient",
+    "LightStore",
+    "Provider",
+    "HTTPProvider",
+    "NodeProvider",
+    "TrustOptions",
+    "SEQUENTIAL",
+    "SKIPPING",
+    "verify",
+    "verify_adjacent",
+    "verify_non_adjacent",
+]

@@ -1,0 +1,167 @@
+"""Light client verification core (reference: light/verifier.go).
+
+``verify_adjacent`` (:91) checks a height+1 header against the trusted
+header's next-validators hash; ``verify_non_adjacent`` (:30) checks an
+arbitrary later header by requiring >1/3 (trust level) of the TRUSTED
+validator set to have signed it, then +2/3 of its own set.  Both commit
+checks route through the batch-verifier seam (the TPU path).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from fractions import Fraction
+
+from cometbft_tpu.types import validation
+from cometbft_tpu.types.basic import Timestamp
+from cometbft_tpu.types.light import LightBlock
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+class LightClientError(Exception):
+    pass
+
+
+class VerificationError(LightClientError):
+    pass
+
+
+class ErrOldHeaderExpired(VerificationError):
+    pass
+
+
+class ErrInvalidHeader(VerificationError):
+    pass
+
+
+@dataclass
+class TrustOptions:
+    """Reference: light/client.go TrustOptions."""
+
+    period_s: int  # trusting period
+    height: int
+    hash: bytes
+
+    def validate(self) -> None:
+        if self.period_s <= 0:
+            raise LightClientError("trusting period must be positive")
+        if self.height <= 0:
+            raise LightClientError("trust height must be positive")
+        if len(self.hash) != 32:
+            raise LightClientError("trust hash must be 32 bytes")
+
+
+def header_expired(header_time: Timestamp, trusting_period_s: int, now: float) -> bool:
+    """Reference: light/verifier.go HeaderExpired."""
+    return header_time.to_ns() / 1e9 + trusting_period_s <= now
+
+
+def _validate_new_block(
+    chain_id: str,
+    trusted: LightBlock,
+    new: LightBlock,
+    now: float,
+    max_clock_drift_s: float,
+) -> None:
+    err = new.validate_basic(chain_id)
+    if err:
+        raise ErrInvalidHeader(err)
+    if new.height <= trusted.height:
+        raise ErrInvalidHeader(
+            f"new height {new.height} <= trusted {trusted.height}"
+        )
+    if new.signed_header.header.time.to_ns() <= trusted.signed_header.header.time.to_ns():
+        raise ErrInvalidHeader("new header time is not after trusted header time")
+    if new.signed_header.header.time.to_ns() / 1e9 > now + max_clock_drift_s:
+        raise ErrInvalidHeader("new header is from the future")
+
+
+def verify_adjacent(
+    chain_id: str,
+    trusted: LightBlock,
+    new: LightBlock,
+    trusting_period_s: int,
+    now: float,
+    max_clock_drift_s: float = 10.0,
+) -> None:
+    """Reference: light/verifier.go:91 VerifyAdjacent."""
+    if new.height != trusted.height + 1:
+        raise ErrInvalidHeader("headers must be adjacent")
+    if header_expired(trusted.signed_header.header.time, trusting_period_s, now):
+        raise ErrOldHeaderExpired("trusted header expired")
+    _validate_new_block(chain_id, trusted, new, now, max_clock_drift_s)
+    if (
+        new.signed_header.header.validators_hash
+        != trusted.signed_header.header.next_validators_hash
+    ):
+        raise ErrInvalidHeader(
+            "new validators hash does not match trusted next_validators_hash"
+        )
+    validation.verify_commit_light(
+        chain_id,
+        new.validator_set,
+        new.signed_header.commit.block_id,
+        new.height,
+        new.signed_header.commit,
+    )
+
+
+def verify_non_adjacent(
+    chain_id: str,
+    trusted: LightBlock,
+    new: LightBlock,
+    trusting_period_s: int,
+    now: float,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    max_clock_drift_s: float = 10.0,
+) -> None:
+    """Reference: light/verifier.go:30 VerifyNonAdjacent."""
+    if new.height == trusted.height + 1:
+        return verify_adjacent(
+            chain_id, trusted, new, trusting_period_s, now, max_clock_drift_s
+        )
+    if header_expired(trusted.signed_header.header.time, trusting_period_s, now):
+        raise ErrOldHeaderExpired("trusted header expired")
+    _validate_new_block(chain_id, trusted, new, now, max_clock_drift_s)
+    # >trust_level of the TRUSTED set signed the new header
+    try:
+        validation.verify_commit_light_trusting(
+            chain_id,
+            trusted.validator_set,
+            new.signed_header.commit,
+            trust_level=trust_level,
+        )
+    except validation.NotEnoughPowerError as e:
+        raise ErrNewValSetCantBeTrusted(str(e)) from e
+    # and +2/3 of the NEW set signed it
+    validation.verify_commit_light(
+        chain_id,
+        new.validator_set,
+        new.signed_header.commit.block_id,
+        new.height,
+        new.signed_header.commit,
+    )
+
+
+class ErrNewValSetCantBeTrusted(VerificationError):
+    """Not enough trusted power signed: bisect (reference:
+    ErrNewValSetCantBeTrusted)."""
+
+
+def verify(
+    chain_id: str,
+    trusted: LightBlock,
+    new: LightBlock,
+    trusting_period_s: int,
+    now: float,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """Reference: light/verifier.go:128 Verify."""
+    if new.height == trusted.height + 1:
+        verify_adjacent(chain_id, trusted, new, trusting_period_s, now)
+    else:
+        verify_non_adjacent(
+            chain_id, trusted, new, trusting_period_s, now, trust_level
+        )

@@ -208,12 +208,41 @@ class Node(BaseService):
             logger=self.logger.with_(module="p2p"),
         )
 
+        # phased startup (reference: node OnStart — statesync → blocksync →
+        # consensus): statesync only for a fresh node with it enabled;
+        # blocksync unless we are the only validator (reference:
+        # node/node.go onlyValidatorIsUs — a solo validator can't sync
+        # from anyone and must propose immediately)
+        self.statesync_active = (
+            config.statesync.enable and self.state.last_block_height == 0
+        )
+        block_sync = not self._only_validator_is_us()
         self.consensus_reactor = ConsensusReactor(
             self.consensus,
             self.block_store,
+            wait_sync=block_sync or self.statesync_active,
             logger=self.logger.with_(module="consensus-reactor"),
         )
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+
+        from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+
+        self.blocksync_reactor = BlocksyncReactor(
+            self.state,
+            self.block_exec,
+            self.block_store,
+            consensus_reactor=self.consensus_reactor,
+            enabled=block_sync and not self.statesync_active,
+            logger=self.logger.with_(module="blocksync"),
+        )
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+
+        from cometbft_tpu.statesync.reactor import StatesyncReactor
+
+        self.statesync_reactor = StatesyncReactor(
+            self.proxy_app, logger=self.logger.with_(module="statesync")
+        )
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
         if isinstance(self.mempool, CListMempool):
             self.mempool_reactor = MempoolReactor(
                 config.mempool,
@@ -267,6 +296,12 @@ class Node(BaseService):
                 self.switch.dial_peers_async(
                     self.config.p2p.persistent_peers, persistent=True
                 )
+            if self.statesync_active:
+                threading.Thread(
+                    target=self._run_statesync,
+                    name="statesync",
+                    daemon=True,
+                ).start()
         else:
             self.consensus.start()
         if self.mempool.txs_available() is not None:
@@ -280,6 +315,65 @@ class Node(BaseService):
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
         )
+
+    def _run_statesync(self) -> None:
+        """Reference: node/setup.go:560 startStateSync — restore a snapshot,
+        bootstrap the stores, then hand off to blocksync."""
+        from cometbft_tpu.light.provider import HTTPProvider
+        from cometbft_tpu.light.verifier import TrustOptions
+        from cometbft_tpu.statesync.stateprovider import (
+            LightClientStateProvider,
+        )
+        from cometbft_tpu.statesync.syncer import Syncer
+
+        cfg = self.config.statesync
+        try:
+            providers = [
+                HTTPProvider(self.genesis_doc.chain_id, url)
+                for url in cfg.rpc_servers
+            ]
+            state_provider = LightClientStateProvider(
+                self.genesis_doc.chain_id,
+                providers,
+                TrustOptions(
+                    period_s=cfg.trust_period_s,
+                    height=cfg.trust_height,
+                    hash=bytes.fromhex(cfg.trust_hash),
+                ),
+                genesis_doc=self.genesis_doc,
+                logger=self.logger.with_(module="statesync-provider"),
+            )
+            syncer = Syncer(
+                state_provider,
+                self.proxy_app,
+                self.statesync_reactor.request_chunk,
+                chunk_timeout=cfg.chunk_request_timeout_s,
+                logger=self.logger.with_(module="statesync"),
+            )
+            self.statesync_reactor.syncer = syncer
+            self.statesync_reactor.request_snapshots()
+            state, commit = syncer.sync_any(
+                cfg.discovery_time_s,
+                lambda: self.is_running,
+                rediscover=self.statesync_reactor.request_snapshots,
+            )
+        except Exception as e:  # noqa: BLE001
+            self.logger.error(
+                "statesync failed, falling back to blocksync", err=repr(e)
+            )
+            self.statesync_reactor.syncer = None
+            self.blocksync_reactor.start_sync(self.state)
+            return
+        self.statesync_reactor.syncer = None
+        # bootstrap stores (reference: node/setup.go:587-601)
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(state.last_block_height, commit)
+        self.state = state
+        self.evidence_pool.state = state
+        self.logger.info(
+            "statesync complete", height=state.last_block_height
+        )
+        self.blocksync_reactor.start_sync(state)
 
     def _tx_waiter(self) -> None:
         """Forward mempool txs-available pulses into consensus (reference:
@@ -301,6 +395,13 @@ class Node(BaseService):
         self.proxy_app.stop()
         self.db.close()
         self.logger.info("node stopped")
+
+    def _only_validator_is_us(self) -> bool:
+        """Reference: node/node.go onlyValidatorIsUs."""
+        vals = self.state.validators
+        if len(vals) != 1:
+            return False
+        return vals.validators[0].address == self.priv_validator.pub_key().address()
 
     # -- introspection -----------------------------------------------------
 

@@ -611,11 +611,24 @@ class Environment:
             out.append(self.block(h))
         return {"blocks": out, "total_count": str(len(heights))}
 
-    def broadcast_evidence(self, evidence: dict) -> dict:
+    def broadcast_evidence(self, evidence) -> dict:
+        """Reference: rpc/core/evidence.go BroadcastEvidence.  ``evidence``
+        is the proto-encoded evidence (base64/hex/quoted per _bytes_arg)."""
+        from cometbft_tpu.types import codec as _codec
+        from cometbft_tpu.types.evidence import EvidenceError
+
         pool = getattr(self.node, "evidence_pool", None)
         if pool is None:
             raise RPCError(-32603, "evidence pool is disabled")
-        raise RPCError(-32603, "evidence JSON decoding not yet supported")
+        try:
+            ev = _codec.decode_evidence(_bytes_arg(evidence))
+        except (ValueError, KeyError) as e:
+            raise RPCError(-32602, f"undecodable evidence: {e}") from e
+        try:
+            pool.add_evidence(ev)
+        except EvidenceError as e:
+            raise RPCError(-32603, f"evidence rejected: {e}") from e
+        return {"hash": _hex(ev.hash())}
 
 
 # route name -> method name (reference: rpc/core/routes.go)

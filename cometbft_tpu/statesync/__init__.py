@@ -1,0 +1,15 @@
+from cometbft_tpu.statesync.reactor import (
+    CHUNK_CHANNEL,
+    SNAPSHOT_CHANNEL,
+    StatesyncReactor,
+)
+from cometbft_tpu.statesync.stateprovider import LightClientStateProvider
+from cometbft_tpu.statesync.syncer import Syncer
+
+__all__ = [
+    "StatesyncReactor",
+    "Syncer",
+    "LightClientStateProvider",
+    "SNAPSHOT_CHANNEL",
+    "CHUNK_CHANNEL",
+]

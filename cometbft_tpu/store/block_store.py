@@ -205,6 +205,10 @@ class BlockStore:
         raw = self._db.get(_k_commit(height))
         return codec.decode_commit(raw) if raw else None
 
+    def save_seen_commit(self, height: int, commit: Commit) -> None:
+        """Reference: store.go SaveSeenCommit (used by statesync bootstrap)."""
+        self._db.set(_k_seen_commit(height), codec.encode_commit(commit))
+
     def load_seen_commit(self, height: int) -> Optional[Commit]:
         raw = self._db.get(_k_seen_commit(height))
         return codec.decode_commit(raw) if raw else None

@@ -194,3 +194,43 @@ class TestEvidenceGossip:
         assert _wait_for(
             lambda: all(committed_in_block(n) for n in net), timeout=60
         ), "evidence did not commit on all nodes"
+
+
+class TestBlocksync:
+    def test_late_joiner_blocksyncs_to_head(self, net, tmp_path):
+        """A fresh non-validator node joins after the chain has advanced and
+        catches up via the blocksync pool (two-block verify pipeline)."""
+        assert _wait_for(lambda: net[0].consensus.height >= 5, timeout=60)
+        target = net[0].block_store.height()
+
+        gdoc_json = open(
+            os.path.join(net[0].config.base.home, "config", "genesis.json")
+        ).read()
+        from cometbft_tpu.types.genesis import GenesisDoc
+
+        gdoc = GenesisDoc.from_json(gdoc_json)
+        joiner_priv = Ed25519PrivKey.generate()  # NOT a validator
+        cfg = _make_node_home(tmp_path, 99, gdoc, joiner_priv)
+        addr0 = net[0].switch.transport.listen_addr
+        cfg.p2p.persistent_peers = [
+            f"{net[0].node_key.node_id}@127.0.0.1:{addr0[1]}"
+        ]
+        joiner = Node(cfg)
+        joiner.start()
+        try:
+            assert joiner.blocksync_reactor.syncing  # started in sync mode
+            assert _wait_for(
+                lambda: joiner.block_store.height() >= target, timeout=60
+            ), (
+                f"joiner at {joiner.block_store.height()}, target {target}"
+            )
+            # after catchup it must have switched to consensus and follow live
+            assert _wait_for(
+                lambda: not joiner.blocksync_reactor.syncing, timeout=30
+            )
+            live_target = net[0].block_store.height() + 2
+            assert _wait_for(
+                lambda: joiner.block_store.height() >= live_target, timeout=60
+            ), "joiner does not follow live consensus after blocksync"
+        finally:
+            joiner.stop()
