@@ -19,7 +19,6 @@ from cometbft_tpu.libs import log as liblog
 
 REQUEST_WINDOW = 40  # max heights in flight (reference: maxPendingRequests=600, scaled down)
 REQUEST_TIMEOUT = 15.0  # reassign a request after this long
-MIN_RECV_RATE = 0  # bytes/sec floor (reference: minRecvRate, disabled here)
 
 
 @dataclass
@@ -56,7 +55,6 @@ class BlockPool:
         self.requests: dict[int, _Request] = {}
         self.ever_had_peers = False
         self._started_at = time.monotonic()
-        self._last_advance = time.monotonic()
 
     # -- peers -------------------------------------------------------------
 
@@ -133,7 +131,6 @@ class BlockPool:
         with self._lock:
             self.requests.pop(self.height, None)
             self.height += 1
-            self._last_advance = time.monotonic()
 
     def redo_request(self, height: int) -> str:
         """Bad block at ``height``: drop the block, ban the sender
@@ -198,6 +195,3 @@ class BlockPool:
             if not self.peers:
                 return False
             return self.height >= self.max_peer_height()
-
-    def stalled_for(self) -> float:
-        return time.monotonic() - self._last_advance

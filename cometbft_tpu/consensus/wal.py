@@ -54,50 +54,93 @@ class WAL:
         self.path = path
         self.head_size_limit = head_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "ab")
+        # write path: native C++ engine when available (same frame bytes;
+        # cometbft_tpu/native csrc wal_*), else buffered Python file
+        from cometbft_tpu import native as _native
+
+        self._nlib = _native.lib()
+        self._nh = None
+        self._f = None
+        self._open_head()
+
+    def _open_head(self) -> None:
+        if self._nlib is not None:
+            self._nh = self._nlib.wal_open(self.path.encode())
+        if self._nh is None:
+            self._nlib = None
+            self._f = open(self.path, "ab")
 
     # -- writing ----------------------------------------------------------
+
+    def _append(self, kind: int, payload: bytes, sync: bool) -> None:
+        if self._nh is not None:
+            rc = self._nlib.wal_append(
+                self._nh, kind, payload, len(payload), 1 if sync else 0
+            )
+            if rc != 0:
+                raise OSError("native WAL append failed")
+        else:
+            self._f.write(_frame(kind, payload))
+            if sync:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+
+    def _head_size(self) -> int:
+        if self._nh is not None:
+            return self._nlib.wal_size(self._nh)
+        return self._f.tell()
 
     def write(self, payload: bytes) -> None:
         """Buffered write (peer messages; reference: state.go:842)."""
         if len(payload) > MAX_MSG_SIZE:
             raise ValueError("WAL message too large")
-        self._f.write(_frame(_REC_DATA, payload))
+        self._append(_REC_DATA, payload, sync=False)
         self._maybe_rotate()
 
     def write_sync(self, payload: bytes) -> None:
         """Write + flush + fsync (internal messages; reference: state.go:850)."""
-        self.write(payload)
-        self.flush_and_sync()
+        if len(payload) > MAX_MSG_SIZE:
+            raise ValueError("WAL message too large")
+        self._append(_REC_DATA, payload, sync=True)
+        self._maybe_rotate()
 
     def write_end_height(self, height: int) -> None:
         """#ENDHEIGHT marker, fsync'd (reference: state.go:1904)."""
-        self._f.write(_frame(_REC_END_HEIGHT, height.to_bytes(8, "big")))
-        self.flush_and_sync()
+        self._append(_REC_END_HEIGHT, height.to_bytes(8, "big"), sync=True)
         self._maybe_rotate()
 
     def flush_and_sync(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        if self._nh is not None:
+            self._nlib.wal_sync(self._nh)
+        elif self._f is not None:
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def _maybe_rotate(self) -> None:
-        if self._f.tell() < self.head_size_limit:
+        if self._head_size() < self.head_size_limit:
             return
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._f.close()
+        self._close_head()
         idx = 0
         while os.path.exists(f"{self.path}.{idx:03d}"):
             idx += 1
         os.rename(self.path, f"{self.path}.{idx:03d}")
-        self._f = open(self.path, "ab")
+        self._open_head()
+
+    def _close_head(self) -> None:
+        if self._nh is not None:
+            self._nlib.wal_close(self._nh)
+            self._nh = None
+        if self._f is not None:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            self._f = None
 
     def close(self) -> None:
         try:
-            self.flush_and_sync()
+            self._close_head()
         except (OSError, ValueError):
             pass
-        self._f.close()
 
     # -- reading / replay -------------------------------------------------
 
@@ -119,7 +162,8 @@ class WAL:
         return out
 
     def iter_records(self, strict: bool = True) -> Iterator[WALRecord]:
-        self._f.flush()
+        if self._f is not None:
+            self._f.flush()
         for fp in self._files():
             with open(fp, "rb") as f:
                 while True:

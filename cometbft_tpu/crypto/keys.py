@@ -88,8 +88,25 @@ class Ed25519PrivKey:
 def pub_key_from_type(key_type: str, data: bytes):
     if key_type == ED25519_KEY_TYPE:
         return Ed25519PubKey(data)
+    if key_type == SECP256K1_KEY_TYPE:
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PubKey
+
+        return Secp256k1PubKey(data)
+    raise ValueError(f"unsupported key type: {key_type}")
+
+
+def priv_key_generate(key_type: str = ED25519_KEY_TYPE):
+    """Reference: internal/keytypes registry + privval key generation."""
+    if key_type == ED25519_KEY_TYPE:
+        return Ed25519PrivKey.generate()
+    if key_type == SECP256K1_KEY_TYPE:
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+
+        return Secp256k1PrivKey.generate()
     raise ValueError(f"unsupported key type: {key_type}")
 
 
 def supported_key_types() -> list[str]:
-    return [ED25519_KEY_TYPE]
+    """bls12_381 is gated off (reference: build-tag gated blst backend,
+    crypto/bls12381/key.go Enabled=false without the tag)."""
+    return [ED25519_KEY_TYPE, SECP256K1_KEY_TYPE]

@@ -1,0 +1,115 @@
+"""secp256k1 ECDSA keys (reference: crypto/secp256k1/secp256k1.go).
+
+Bitcoin-style addressing: RIPEMD160(SHA256(33-byte compressed pubkey)).
+Signatures are 64-byte R||S with low-S normalization (the reference's
+btcec serialization).  Backed by the `cryptography` library's C
+implementation; no batch verification (matches the reference: secp256k1
+has no BatchVerifier, crypto/batch falls back to sequential).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+SECP256K1_KEY_TYPE = "secp256k1"
+
+# curve order (for low-S normalization)
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _address(pub33: bytes) -> bytes:
+    sha = hashlib.sha256(pub33).digest()
+    rip = hashlib.new("ripemd160")
+    rip.update(sha)
+    return rip.digest()
+
+
+@dataclass(frozen=True)
+class Secp256k1PubKey:
+    data: bytes  # 33-byte compressed SEC1 point
+
+    type_ = SECP256K1_KEY_TYPE
+
+    def __post_init__(self):
+        if len(self.data) != 33:
+            raise ValueError("secp256k1 pubkey must be 33 bytes (compressed)")
+
+    def address(self) -> bytes:
+        addr = self.__dict__.get("_addr")
+        if addr is None:
+            addr = _address(self.data)
+            self.__dict__["_addr"] = addr
+        return addr
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != 64:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if r == 0 or s == 0 or s > _N // 2:  # reject non-low-S (reference)
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self.data
+            )
+            pub.verify(
+                encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def bytes(self) -> bytes:
+        return self.data
+
+
+@dataclass(frozen=True)
+class Secp256k1PrivKey:
+    secret: bytes  # 32-byte scalar
+
+    type_ = SECP256K1_KEY_TYPE
+
+    @staticmethod
+    def generate() -> "Secp256k1PrivKey":
+        key = ec.generate_private_key(ec.SECP256K1())
+        raw = key.private_numbers().private_value.to_bytes(32, "big")
+        return Secp256k1PrivKey(raw)
+
+    @staticmethod
+    def from_secret(secret: bytes) -> "Secp256k1PrivKey":
+        return Secp256k1PrivKey(secret)
+
+    def _key(self) -> ec.EllipticCurvePrivateKey:
+        return ec.derive_private_key(
+            int.from_bytes(self.secret, "big"), ec.SECP256K1()
+        )
+
+    def pub_key(self) -> Secp256k1PubKey:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        pub = self._key().public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint
+        )
+        return Secp256k1PubKey(pub)
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:
+            s = _N - s  # low-S normalization
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def bytes(self) -> bytes:
+        return self.secret

@@ -1,0 +1,4 @@
+from cometbft_tpu.indexer.kv import KVBlockIndexer, KVTxIndexer, TxResult
+from cometbft_tpu.indexer.service import IndexerService
+
+__all__ = ["KVTxIndexer", "KVBlockIndexer", "TxResult", "IndexerService"]

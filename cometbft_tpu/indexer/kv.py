@@ -1,0 +1,277 @@
+"""KV event indexers (reference: state/txindex/kv/kv.go and
+state/indexer/block/kv/kv.go).
+
+Compound keys make range queries a prefix scan:
+  tx primary   ``txh/<hash>``                          -> TxResult record
+  tx event     ``txe/<tag>/<value>/<height>/<index>``  -> tx hash
+  block event  ``bhe/<tag>/<value>/<height>``          -> b""
+
+Search evaluates a query's conditions as index scans and intersects the
+candidate sets (the reference's approach for its compound keyspace).
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.crypto import tmhash
+from cometbft_tpu.libs.pubsub import Query
+
+_TX_PRIMARY = b"txh/"
+_TX_EVENT = b"txe/"
+_BLOCK_EVENT = b"bhe/"
+
+TX_HASH_TAG = "tx.hash"
+TX_HEIGHT_TAG = "tx.height"
+BLOCK_HEIGHT_TAG = "block.height"
+
+
+@dataclass
+class TxResult:
+    """Reference: abci/types.TxResult + rpc ResultTx shape."""
+
+    height: int
+    index: int
+    tx: bytes
+    result: at.ExecTxResult
+
+    @property
+    def hash(self) -> bytes:
+        return tmhash.sum256(self.tx)
+
+    def to_json(self) -> dict:
+        return {
+            "hash": self.hash.hex().upper(),
+            "height": str(self.height),
+            "index": self.index,
+            "tx_result": {
+                "code": self.result.code,
+                "data": base64.b64encode(self.result.data).decode(),
+                "log": self.result.log,
+                "gas_wanted": str(self.result.gas_wanted),
+                "gas_used": str(self.result.gas_used),
+                "events": [
+                    {
+                        "type": e.type_,
+                        "attributes": [
+                            {
+                                "key": a.key,
+                                "value": a.value,
+                                "index": a.index,
+                            }
+                            for a in e.attributes
+                        ],
+                    }
+                    for e in self.result.events
+                ],
+                "codespace": self.result.codespace,
+            },
+            "tx": base64.b64encode(self.tx).decode(),
+        }
+
+    def encode(self) -> bytes:
+        import json
+
+        ev = [
+            {
+                "type": e.type_,
+                "attributes": [
+                    {"key": a.key, "value": a.value, "index": a.index}
+                    for a in e.attributes
+                ],
+            }
+            for e in self.result.events
+        ]
+        doc = {
+            "height": self.height,
+            "index": self.index,
+            "tx": base64.b64encode(self.tx).decode(),
+            "result": {
+                "code": self.result.code,
+                "data": base64.b64encode(self.result.data).decode(),
+                "log": self.result.log,
+                "gas_wanted": self.result.gas_wanted,
+                "gas_used": self.result.gas_used,
+                "events": ev,
+                "codespace": self.result.codespace,
+            },
+        }
+        return json.dumps(doc, sort_keys=True).encode()
+
+    @staticmethod
+    def decode(raw: bytes) -> "TxResult":
+        import json
+
+        doc = json.loads(raw.decode())
+        r = doc["result"]
+        return TxResult(
+            height=doc["height"],
+            index=doc["index"],
+            tx=base64.b64decode(doc["tx"]),
+            result=at.ExecTxResult(
+                code=r["code"],
+                data=base64.b64decode(r["data"]),
+                log=r["log"],
+                gas_wanted=r["gas_wanted"],
+                gas_used=r["gas_used"],
+                events=[
+                    at.Event(
+                        type_=e["type"],
+                        attributes=[
+                            at.EventAttribute(
+                                a["key"], a["value"], a["index"]
+                            )
+                            for a in e["attributes"]
+                        ],
+                    )
+                    for e in r["events"]
+                ],
+                codespace=r["codespace"],
+            ),
+        )
+
+
+def _event_key(prefix: bytes, tag: str, value: str, height: int, index: int = -1) -> bytes:
+    key = (
+        prefix
+        + tag.encode()
+        + b"/"
+        + value.encode()
+        + b"/"
+        + struct.pack(">q", height)
+    )
+    if index >= 0:
+        key += struct.pack(">I", index)
+    return key
+
+
+def _indexed_tags(events) -> list[tuple[str, str]]:
+    """(tag, value) pairs for attributes flagged index=True
+    (reference: kv.go indexEvents honors the Index flag)."""
+    out = []
+    for ev in events or []:
+        for attr in ev.attributes:
+            if attr.index and attr.key:
+                out.append((f"{ev.type_}.{attr.key}", attr.value))
+    return out
+
+
+class KVTxIndexer:
+    """Reference: state/txindex/kv/kv.go TxIndex."""
+
+    def __init__(self, db):
+        self._db = db
+
+    def index(self, height: int, index: int, tx: bytes, result: at.ExecTxResult):
+        rec = TxResult(height=height, index=index, tx=tx, result=result)
+        h = rec.hash
+        sets = [(_TX_PRIMARY + h, rec.encode())]
+        # implicit tags
+        sets.append(
+            (_event_key(_TX_EVENT, TX_HEIGHT_TAG, str(height), height, index), h)
+        )
+        for tag, value in _indexed_tags(result.events):
+            sets.append((_event_key(_TX_EVENT, tag, value, height, index), h))
+        self._db.write_batch(sets, [])
+
+    def get(self, hash_: bytes) -> Optional[TxResult]:
+        raw = self._db.get(_TX_PRIMARY + hash_)
+        return TxResult.decode(raw) if raw else None
+
+    def search(self, query: Query) -> list[TxResult]:
+        """Intersect per-condition candidate hash sets (reference:
+        kv.go Search)."""
+        result_set: Optional[set[bytes]] = None
+        for cond in query.conditions:
+            if cond.tag == TX_HASH_TAG and cond.op == "=":
+                h = bytes.fromhex(str(cond.operand))
+                cands = {h} if self._db.get(_TX_PRIMARY + h) else set()
+            else:
+                cands = self._scan_condition(cond)
+            result_set = cands if result_set is None else (result_set & cands)
+            if not result_set:
+                return []
+        out = []
+        for h in result_set or set():
+            rec = self.get(h)
+            if rec is not None:
+                out.append(rec)
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+    def _scan_condition(self, cond) -> set[bytes]:
+        prefix = _TX_EVENT + cond.tag.encode() + b"/"
+        out: set[bytes] = set()
+        for key, val in self._db.iterate(prefix, prefix + b"\xff"):
+            # key layout: prefix + value + "/" + height(8) + index(4)
+            body = key[len(prefix) : -12]
+            value = body[:-1].decode(errors="replace")  # strip trailing "/"
+            if _match_value(cond, value):
+                out.add(bytes(val))
+        return out
+
+
+class KVBlockIndexer:
+    """Reference: state/indexer/block/kv/kv.go BlockerIndexer."""
+
+    def __init__(self, db):
+        self._db = db
+
+    def index(self, height: int, events) -> None:
+        sets = [
+            (
+                _event_key(_BLOCK_EVENT, BLOCK_HEIGHT_TAG, str(height), height),
+                b"",
+            )
+        ]
+        for tag, value in _indexed_tags(events):
+            sets.append((_event_key(_BLOCK_EVENT, tag, value, height), b""))
+        self._db.write_batch(sets, [])
+
+    def search(self, query: Query) -> list[int]:
+        result_set: Optional[set[int]] = None
+        for cond in query.conditions:
+            cands = self._scan_condition(cond)
+            result_set = cands if result_set is None else (result_set & cands)
+            if not result_set:
+                return []
+        return sorted(result_set or set())
+
+    def _scan_condition(self, cond) -> set[int]:
+        prefix = _BLOCK_EVENT + cond.tag.encode() + b"/"
+        out: set[int] = set()
+        for key, _val in self._db.iterate(prefix, prefix + b"\xff"):
+            body = key[len(prefix) :]
+            value = body[:-9].decode(errors="replace")  # strip "/"+height(8)
+            height = struct.unpack(">q", body[-8:])[0]
+            if _match_value(cond, value):
+                out.add(height)
+        return out
+
+
+def _match_value(cond, value: str) -> bool:
+    if cond.op == "EXISTS":
+        return True
+    if cond.op == "=":
+        if isinstance(cond.operand, (int, float)):
+            try:
+                return float(value) == float(cond.operand)
+            except ValueError:
+                return False
+        return value == str(cond.operand)
+    if cond.op == "CONTAINS":
+        return str(cond.operand) in value
+    try:
+        fv, fo = float(value), float(cond.operand)
+    except (TypeError, ValueError):
+        return False
+    return (
+        (cond.op == "<" and fv < fo)
+        or (cond.op == "<=" and fv <= fo)
+        or (cond.op == ">" and fv > fo)
+        or (cond.op == ">=" and fv >= fo)
+    )

@@ -1,0 +1,82 @@
+"""Indexer service: subscribes to the event bus and feeds the indexers.
+
+Reference: state/txindex/indexer_service.go — one subscriber draining
+NewBlockEvents + Tx events so tx_search/block_search stay current.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.libs.pubsub import Query
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.types import events as tev
+
+_SUBSCRIBER = "IndexerService"
+
+
+class IndexerService(BaseService):
+    """Reference: txindex/indexer_service.go IndexerService."""
+
+    def __init__(self, tx_indexer, block_indexer, event_bus, logger=None):
+        super().__init__("IndexerService")
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self.logger = logger or liblog.nop_logger()
+        self._thread = None
+        self._tx_sub = None
+        self._block_sub = None
+
+    def on_start(self) -> None:
+        self._tx_sub = self.event_bus.subscribe(
+            _SUBSCRIBER,
+            Query.parse(f"{tev.EVENT_TYPE_KEY}='{tev.EVENT_TX}'"),
+            capacity=1000,
+        )
+        self._block_sub = self.event_bus.subscribe(
+            _SUBSCRIBER,
+            Query.parse(f"{tev.EVENT_TYPE_KEY}='{tev.EVENT_NEW_BLOCK_EVENTS}'"),
+            capacity=100,
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="indexer", daemon=True
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        try:
+            self.event_bus.unsubscribe_all(_SUBSCRIBER)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _run(self) -> None:
+        while self.is_running:
+            # drain BOTH queues completely each wakeup — blocking on one
+            # starves the other and a full queue gets its subscription
+            # canceled by the pubsub server
+            drained = 0
+            while True:
+                msg = self._block_sub.next(timeout=0)
+                if msg is None:
+                    break
+                drained += 1
+                data: tev.EventDataNewBlockEvents = msg.data
+                try:
+                    self.block_indexer.index(data.height, data.events)
+                except Exception as e:  # noqa: BLE001
+                    self.logger.error("block index failed", err=repr(e))
+            while True:
+                tx_msg = self._tx_sub.next(timeout=0)
+                if tx_msg is None:
+                    break
+                drained += 1
+                d: tev.EventDataTx = tx_msg.data
+                try:
+                    self.tx_indexer.index(d.height, d.index, d.tx, d.result)
+                except Exception as e:  # noqa: BLE001
+                    self.logger.error("tx index failed", err=repr(e))
+            if not drained:
+                time.sleep(0.02)

@@ -130,17 +130,28 @@ class LightClient:
                 f"{trusted.height} (use a store with earlier blocks)"
             )
         target = self.primary.light_block(height)
+        # verify first (collecting the chain of newly trusted blocks), then
+        # cross-check against witnesses, and only THEN persist: a header the
+        # witnesses dispute must never enter the trusted store (reference:
+        # detector runs before the store write, client.go:522-534)
+        verified: list[LightBlock] = []
         if self.mode == SEQUENTIAL:
-            self._verify_sequential(trusted, target, now)
+            self._verify_sequential(trusted, target, now, verified)
         else:
-            self._verify_skipping(trusted, target, now)
+            self._verify_skipping(trusted, target, now, verified)
         self._detect_divergence(target, now)
+        for lb in verified:
+            self.store.save_light_block(lb)
         return target
 
     # -- sequential (reference: client.go:608) -----------------------------
 
     def _verify_sequential(
-        self, trusted: LightBlock, target: LightBlock, now: float
+        self,
+        trusted: LightBlock,
+        target: LightBlock,
+        now: float,
+        verified: list,
     ) -> None:
         current = trusted
         for h in range(trusted.height + 1, target.height + 1):
@@ -157,13 +168,17 @@ class LightClient:
                 now,
                 self.max_clock_drift_s,
             )
-            self.store.save_light_block(lb)
+            verified.append(lb)
             current = lb
 
     # -- skipping / bisection (reference: client.go:701) -------------------
 
     def _verify_skipping(
-        self, trusted: LightBlock, target: LightBlock, now: float
+        self,
+        trusted: LightBlock,
+        target: LightBlock,
+        now: float,
+        verified: list,
     ) -> None:
         current = trusted
         pending = [target]
@@ -188,16 +203,22 @@ class LightClient:
                     )
                 pending.append(self.primary.light_block(mid))
                 continue
-            self.store.save_light_block(candidate)
+            verified.append(candidate)
             current = candidate
             pending.pop()
 
     # -- detector (reference: light/detector.go) ---------------------------
 
     def _detect_divergence(self, verified: LightBlock, now: float) -> None:
+        """Cross-check the primary's header against every witness; on
+        divergence, report attack evidence BOTH ways (either side could be
+        the liar — reference: light/detector.go submits to primary and
+        witness) and raise without trusting the header.  Neither provider is
+        evicted here: the caller decides whom to keep."""
         if not self.witnesses:
             return
-        faulty = []
+        diverged = 0
+        common = self.store.light_block_before(verified.height)
         for w in self.witnesses:
             try:
                 wlb = w.light_block(verified.height)
@@ -205,34 +226,33 @@ class LightClient:
                 continue  # witness behind / unreachable: skip (ref: detector)
             if wlb.hash() == verified.hash():
                 continue
-            # divergence! build evidence against the witness trace
+            diverged += 1
             self.logger.error(
-                "witness disagrees with primary",
+                "conflicting headers between primary and witness",
                 height=verified.height,
                 witness=w.id(),
             )
-            common = self.store.light_block_before(verified.height)
-            ev = LightClientAttackEvidence(
-                conflicting_block=wlb,
-                common_height=common.height if common else verified.height - 1,
-                total_voting_power=(
-                    common.validator_set.total_voting_power() if common else 0
-                ),
-                timestamp=(
-                    common.signed_header.header.time
-                    if common
-                    else verified.signed_header.header.time
-                ),
-            )
-            try:
-                self.primary.report_evidence(ev)
-            except Exception as e:  # noqa: BLE001 — reporting must not mask detection
-                self.logger.debug("evidence report failed", err=repr(e))
-            faulty.append(w)
-        if faulty:
-            self.witnesses = [w for w in self.witnesses if w not in faulty]
+            for block, reporter in ((wlb, self.primary), (verified, w)):
+                ev = LightClientAttackEvidence(
+                    conflicting_block=block,
+                    common_height=common.height if common else verified.height - 1,
+                    total_voting_power=(
+                        common.validator_set.total_voting_power() if common else 0
+                    ),
+                    timestamp=(
+                        common.signed_header.header.time
+                        if common
+                        else verified.signed_header.header.time
+                    ),
+                )
+                try:
+                    reporter.report_evidence(ev)
+                except Exception as e:  # noqa: BLE001 — must not mask detection
+                    self.logger.debug("evidence report failed", err=repr(e))
+        if diverged:
             raise ErrLightClientDivergence(
-                f"{len(faulty)} witness(es) diverged from the primary"
+                f"{diverged} witness(es) diverged from the primary at height "
+                f"{verified.height}; header NOT trusted"
             )
 
     # -- maintenance -------------------------------------------------------

@@ -47,6 +47,11 @@ class Provider:
     def report_evidence(self, ev) -> None:
         raise NotImplementedError
 
+    def consensus_params(self, height: int):
+        """Consensus params at ``height`` (reference:
+        statesync/stateprovider.go ConsensusParams)."""
+        raise NotImplementedError
+
     def id(self) -> str:
         return repr(self)
 
@@ -114,7 +119,10 @@ _KEY_TYPES = {
 def _parse_validators(items: list[dict]) -> ValidatorSet:
     vals = []
     for v in items:
-        key_type = _KEY_TYPES.get(v["pub_key"]["type"], "ed25519")
+        wire_type = v["pub_key"]["type"]
+        key_type = _KEY_TYPES.get(wire_type)
+        if key_type is None:
+            raise ProviderError(f"unsupported validator key type {wire_type!r}")
         pub = pub_key_from_type(key_type, base64.b64decode(v["pub_key"]["value"]))
         vals.append(
             Validator(
@@ -203,6 +211,12 @@ class HTTPProvider(Provider):
         except ProviderError:
             pass
 
+    def consensus_params(self, height: int):
+        from cometbft_tpu.state.state import _params_from_json
+
+        res = self._rpc("consensus_params", {"height": str(height)})
+        return _params_from_json(res["consensus_params"])
+
 
 class NodeProvider(Provider):
     """In-process provider reading a Node's stores directly (test fixture +
@@ -238,18 +252,9 @@ class NodeProvider(Provider):
         except EvidenceError as e:
             raise ProviderError(f"evidence rejected: {e}") from e
 
-
-def provider_consensus_params(provider, height: int):
-    """Fetch consensus params through a provider (reference:
-    statesync/stateprovider.go ConsensusParams)."""
-    from cometbft_tpu.state.state import _params_from_json
-
-    if isinstance(provider, NodeProvider):
-        params = provider.node.state_store.load_consensus_params(height)
+    def consensus_params(self, height: int):
+        params = self.node.state_store.load_consensus_params(height)
         if params is None:
-            params = provider.node.consensus.state.consensus_params
+            params = self.node.consensus.state.consensus_params
         return params
-    if isinstance(provider, HTTPProvider):
-        res = provider._rpc("consensus_params", {"height": str(height)})
-        return _params_from_json(res["consensus_params"])
-    raise ProviderError(f"provider {provider.id()} cannot serve consensus params")
+

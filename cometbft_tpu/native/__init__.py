@@ -1,0 +1,95 @@
+"""Native (C++) runtime components with build-on-demand + ctypes bindings.
+
+The library is compiled from ``csrc/cometbft_native.cpp`` on first use and
+cached next to the source; every consumer degrades gracefully to its pure
+Python path when the toolchain or the build is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "csrc", "cometbft_native.cpp")
+_SO = os.path.join(_HERE, "_cometbft_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O3",
+                "-shared",
+                "-fPIC",
+                "-std=c++17",
+                "-o",
+                _SO + ".tmp",
+                _SRC,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (subprocess.SubprocessError, OSError, FileNotFoundError):
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it if needed; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("COMETBFT_TPU_NO_NATIVE"):
+            return None
+        fresh = os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        if not fresh and not _build():
+            return None
+        try:
+            cdll = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        # signatures
+        cdll.wal_open.restype = ctypes.c_void_p
+        cdll.wal_open.argtypes = [ctypes.c_char_p]
+        cdll.wal_append.restype = ctypes.c_int
+        cdll.wal_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int,
+        ]
+        cdll.wal_sync.restype = ctypes.c_int
+        cdll.wal_sync.argtypes = [ctypes.c_void_p]
+        cdll.wal_size.restype = ctypes.c_int64
+        cdll.wal_size.argtypes = [ctypes.c_void_p]
+        cdll.wal_close.restype = None
+        cdll.wal_close.argtypes = [ctypes.c_void_p]
+        cdll.ed25519_pack.restype = ctypes.c_int
+        cdll.ed25519_pack.argtypes = [
+            ctypes.c_char_p,  # pubs
+            ctypes.c_char_p,  # sigs
+            ctypes.c_char_p,  # msgs
+            ctypes.POINTER(ctypes.c_int64),  # offsets
+            ctypes.c_int64,  # n
+            ctypes.c_char_p,  # s_out
+            ctypes.c_char_p,  # m_out
+            ctypes.c_char_p,  # s_ok_out
+        ]
+        cdll.sha512.restype = None
+        cdll.sha512.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
+        _lib = cdll
+        return _lib

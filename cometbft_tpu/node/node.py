@@ -82,10 +82,30 @@ class Node(BaseService):
         self.node_key = NodeKey.load_or_generate(
             os.path.join(home, config.base.node_key_file)
         )
-        self.priv_validator = FilePV.load_or_generate(
-            os.path.join(home, config.base.priv_validator_key_file),
-            os.path.join(home, config.base.priv_validator_state_file),
-        )
+        self._signer_endpoint = None
+        if config.base.priv_validator_laddr:
+            # remote signer (reference: node/node.go:383
+            # createAndStartPrivValidatorSocketClient)
+            from cometbft_tpu.privval.signer import (
+                RetrySignerClient,
+                SignerClient,
+                SignerListenerEndpoint,
+            )
+
+            self._signer_endpoint = SignerListenerEndpoint(
+                config.base.priv_validator_laddr,
+                logger=self.logger.with_(module="privval"),
+            )
+            self._signer_endpoint.start()
+            self._signer_endpoint.wait_for_connection()
+            self.priv_validator = RetrySignerClient(
+                SignerClient(self._signer_endpoint)
+            )
+        else:
+            self.priv_validator = FilePV.load_or_generate(
+                os.path.join(home, config.base.priv_validator_key_file),
+                os.path.join(home, config.base.priv_validator_state_file),
+            )
 
         # -- ABCI proxy (reference: node/node.go:359) -----------------------
         if config.base.abci == "builtin":
@@ -99,6 +119,26 @@ class Node(BaseService):
 
         # -- event bus ------------------------------------------------------
         self.event_bus = EventBus()
+
+        # -- indexers (reference: node/node.go:373 createAndStartIndexerService)
+        self.tx_indexer = None
+        self.block_indexer = None
+        self.indexer_service = None
+        if config.tx_index.indexer == "kv":
+            from cometbft_tpu.indexer import (
+                IndexerService,
+                KVBlockIndexer,
+                KVTxIndexer,
+            )
+
+            self.tx_indexer = KVTxIndexer(self.db)
+            self.block_indexer = KVBlockIndexer(self.db)
+            self.indexer_service = IndexerService(
+                self.tx_indexer,
+                self.block_indexer,
+                self.event_bus,
+                logger=self.logger.with_(module="txindex"),
+            )
 
         # -- evidence pool (reference: node/node.go:431 createEvidenceReactor)
         from cometbft_tpu.evidence.pool import EvidencePool
@@ -278,6 +318,8 @@ class Node(BaseService):
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
+        if self.indexer_service is not None:
+            self.indexer_service.start()
         if self.config.rpc.laddr:
             from cometbft_tpu.rpc.core import Environment
             from cometbft_tpu.rpc.server import RPCServer
@@ -392,6 +434,10 @@ class Node(BaseService):
             self.rpc_server.stop()
         if self.addr_book is not None:
             self.addr_book.save()
+        if self.indexer_service is not None:
+            self.indexer_service.stop()
+        if self._signer_endpoint is not None:
+            self._signer_endpoint.stop()
         self.proxy_app.stop()
         self.db.close()
         self.logger.info("node stopped")

@@ -76,7 +76,13 @@ def prepare_batch(
     pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ):
     """Host-side packing.  Returns (arrays, n, structural_ok) where arrays are
-    the padded device inputs and structural_ok marks length-valid entries."""
+    the padded device inputs and structural_ok marks length-valid entries.
+
+    The per-signature SHA-512 + mod-L math runs in the C++ sidecar when
+    available (cometbft_tpu/native — the host half of the verify pipeline,
+    SURVEY.md §7 step 2); the Python loop below is the fallback and the
+    differential oracle for it.
+    """
     n = len(pubs)
     b = bucket_size(max(n, 1))
     pub_arr = np.zeros((b, 32), np.uint8)
@@ -85,23 +91,63 @@ def prepare_batch(
     m_bytes = np.zeros((b, 32), np.uint8)
     s_ok = np.zeros((b,), bool)
     structural = np.zeros((b,), bool)
-    for i in range(n):
-        pub, msg, sig = pubs[i], msgs[i], sigs[i]
-        if len(pub) != 32 or len(sig) != 64:
-            continue
-        structural[i] = True
-        r_enc, s_enc = sig[:32], sig[32:]
-        s = int.from_bytes(s_enc, "little")
-        s_ok[i] = s < L_INT
-        h = int.from_bytes(
-            hashlib.sha512(r_enc + pub + msg).digest(), "little"
-        ) % L_INT
-        m = (L_INT - h) % L_INT
-        pub_arr[i] = np.frombuffer(pub, np.uint8)
-        r_arr[i] = np.frombuffer(r_enc, np.uint8)
-        if s_ok[i]:
-            s_bytes[i] = np.frombuffer(s_enc, np.uint8)
-        m_bytes[i] = np.frombuffer(m.to_bytes(32, "little"), np.uint8)
+
+    native_done = False
+    from cometbft_tpu import native as _native
+
+    nlib = _native.lib()
+    if nlib is not None and n > 0:
+        ok_idx = [
+            i
+            for i in range(n)
+            if len(pubs[i]) == 32 and len(sigs[i]) == 64
+        ]
+        if ok_idx:
+            import ctypes
+
+            k = len(ok_idx)
+            pub_cat = b"".join(pubs[i] for i in ok_idx)
+            sig_cat = b"".join(sigs[i] for i in ok_idx)
+            msg_cat = b"".join(msgs[i] for i in ok_idx)
+            offs = [0]
+            for i in ok_idx:
+                offs.append(offs[-1] + len(msgs[i]))
+            off_arr = (ctypes.c_int64 * (k + 1))(*offs)
+            s_buf = ctypes.create_string_buffer(k * 32)
+            m_buf = ctypes.create_string_buffer(k * 32)
+            ok_buf = ctypes.create_string_buffer(k)
+            rc = nlib.ed25519_pack(
+                pub_cat, sig_cat, msg_cat, off_arr, k, s_buf, m_buf, ok_buf
+            )
+            if rc == 0:
+                idx = np.asarray(ok_idx)
+                structural[idx] = True
+                pub_arr[idx] = np.frombuffer(pub_cat, np.uint8).reshape(k, 32)
+                sig_view = np.frombuffer(sig_cat, np.uint8).reshape(k, 64)
+                r_arr[idx] = sig_view[:, :32]
+                s_bytes[idx] = np.frombuffer(s_buf.raw, np.uint8).reshape(k, 32)
+                m_bytes[idx] = np.frombuffer(m_buf.raw, np.uint8).reshape(k, 32)
+                s_ok[idx] = np.frombuffer(ok_buf.raw, np.uint8).astype(bool)
+                native_done = True
+
+    if not native_done:
+        for i in range(n):
+            pub, msg, sig = pubs[i], msgs[i], sigs[i]
+            if len(pub) != 32 or len(sig) != 64:
+                continue
+            structural[i] = True
+            r_enc, s_enc = sig[:32], sig[32:]
+            s = int.from_bytes(s_enc, "little")
+            s_ok[i] = s < L_INT
+            h = int.from_bytes(
+                hashlib.sha512(r_enc + pub + msg).digest(), "little"
+            ) % L_INT
+            m = (L_INT - h) % L_INT
+            pub_arr[i] = np.frombuffer(pub, np.uint8)
+            r_arr[i] = np.frombuffer(r_enc, np.uint8)
+            if s_ok[i]:
+                s_bytes[i] = np.frombuffer(s_enc, np.uint8)
+            m_bytes[i] = np.frombuffer(m.to_bytes(32, "little"), np.uint8)
 
     a_sign = (pub_arr[:, 31] >> 7).astype(np.int32)
     r_sign = (r_arr[:, 31] >> 7).astype(np.int32)

@@ -1,0 +1,288 @@
+"""Remote signer: validator keys live in a separate process.
+
+Reference: privval/ — the NODE runs a ``SignerListenerEndpoint`` (it
+listens; the remote signer dials IN, so the key machine needs no inbound
+ports) and wraps it in a ``SignerClient`` satisfying the PrivValidator
+interface.  The remote side runs ``SignerServer`` around a FilePV.
+Wire format: 4-byte BE length + JSON {type, ...} with votes/proposals as
+hex of their deterministic proto encoding.
+
+A ``RetrySignerClient`` retries transient endpoint errors (reference:
+privval/retry_signer_client.go).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Optional
+
+from cometbft_tpu.crypto.keys import pub_key_from_type
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.types import codec
+from cometbft_tpu.types.vote import Proposal, Vote
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _send_msg(sock: socket.socket, doc: dict) -> None:
+    raw = json.dumps(doc).encode()
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    hdr = _recv_exact(sock, 4)
+    (n,) = struct.unpack(">I", hdr)
+    if n > 1 << 20:
+        raise RemoteSignerError(f"oversized signer message {n}")
+    return json.loads(_recv_exact(sock, n).decode())
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RemoteSignerError("signer connection closed")
+        buf += chunk
+    return buf
+
+
+def _parse_laddr(laddr: str) -> tuple[str, int]:
+    s = laddr.split("://", 1)[-1]
+    host, _, port = s.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+class SignerListenerEndpoint:
+    """Node side: accept ONE signer connection and serialize requests over
+    it (reference: privval/signer_listener_endpoint.go)."""
+
+    def __init__(self, laddr: str, timeout: float = 5.0, logger=None):
+        self.laddr = laddr
+        self.timeout = timeout
+        self.logger = logger or liblog.nop_logger()
+        self._lock = threading.Lock()
+        self._conn: Optional[socket.socket] = None
+        self._listener: Optional[socket.socket] = None
+        self._conn_ready = threading.Event()
+        self._stopped = False
+
+    def start(self) -> None:
+        host, port = _parse_laddr(self.laddr)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, port))
+        s.listen(1)
+        self._listener = s
+        self.bound_port = s.getsockname()[1]
+        threading.Thread(
+            target=self._accept_routine, name="privval-accept", daemon=True
+        ).start()
+
+    def _accept_routine(self) -> None:
+        while not self._stopped:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return
+            conn.settimeout(self.timeout)
+            with self._lock:
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                self._conn = conn
+            self._conn_ready.set()
+            self.logger.info("remote signer connected", addr=str(addr))
+
+    def wait_for_connection(self, timeout: float = 30.0) -> None:
+        if not self._conn_ready.wait(timeout=timeout):
+            raise RemoteSignerError("no remote signer connected")
+
+    def request(self, doc: dict) -> dict:
+        with self._lock:
+            conn = self._conn
+            if conn is None:
+                raise RemoteSignerError("no signer connection")
+            try:
+                _send_msg(conn, doc)
+                res = _recv_msg(conn)
+            except (OSError, RemoteSignerError) as e:
+                self._conn = None
+                self._conn_ready.clear()
+                raise RemoteSignerError(f"signer io failed: {e}") from e
+        if res.get("error"):
+            raise RemoteSignerError(res["error"])
+        return res
+
+    def stop(self) -> None:
+        self._stopped = True
+        for s in (self._conn, self._listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class SignerClient:
+    """PrivValidator over a SignerListenerEndpoint (reference:
+    privval/signer_client.go)."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint):
+        self.endpoint = endpoint
+        self._pub = None
+
+    def pub_key(self):
+        if self._pub is None:
+            res = self.endpoint.request({"type": "pub_key"})
+            self._pub = pub_key_from_type(
+                res["key_type"], bytes.fromhex(res["pub_key"])
+            )
+        return self._pub
+
+    def sign_vote(self, chain_id: str, vote: Vote, sign_extension: bool = False):
+        res = self.endpoint.request(
+            {
+                "type": "sign_vote",
+                "chain_id": chain_id,
+                "vote": codec.encode_vote(vote).hex(),
+                "sign_extension": sign_extension,
+            }
+        )
+        signed = codec.decode_vote(bytes.fromhex(res["vote"]))
+        vote.signature = signed.signature
+        vote.timestamp = signed.timestamp
+        vote.extension_signature = signed.extension_signature
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        res = self.endpoint.request(
+            {
+                "type": "sign_proposal",
+                "chain_id": chain_id,
+                "proposal": codec.encode_proposal(proposal).hex(),
+            }
+        )
+        signed = codec.decode_proposal(bytes.fromhex(res["proposal"]))
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp
+
+    def ping(self) -> bool:
+        try:
+            self.endpoint.request({"type": "ping"})
+            return True
+        except RemoteSignerError:
+            return False
+
+
+class RetrySignerClient:
+    """Reference: privval/retry_signer_client.go."""
+
+    def __init__(self, inner: SignerClient, retries: int = 5, wait: float = 0.2):
+        self.inner = inner
+        self.retries = retries
+        self.wait = wait
+
+    def _retry(self, fn, *args, **kw):
+        last: Optional[Exception] = None
+        for _ in range(self.retries):
+            try:
+                return fn(*args, **kw)
+            except RemoteSignerError as e:
+                last = e
+                time.sleep(self.wait)
+        raise last  # type: ignore[misc]
+
+    def pub_key(self):
+        return self._retry(self.inner.pub_key)
+
+    def sign_vote(self, chain_id, vote, sign_extension=False):
+        return self._retry(
+            self.inner.sign_vote, chain_id, vote, sign_extension
+        )
+
+    def sign_proposal(self, chain_id, proposal):
+        return self._retry(self.inner.sign_proposal, chain_id, proposal)
+
+
+class SignerServer:
+    """Remote side: dial the node and answer signing requests from a
+    FilePV (reference: privval/signer_server.go + signer_dialer_endpoint)."""
+
+    def __init__(self, addr: str, priv_validator, logger=None):
+        self.addr = addr
+        self.pv = priv_validator
+        self.logger = logger or liblog.nop_logger()
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="signer-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _run(self) -> None:
+        host, port = _parse_laddr(self.addr)
+        while not self._stopped.is_set():
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+            except OSError:
+                time.sleep(0.5)
+                continue
+            self.logger.info("connected to node", addr=self.addr)
+            try:
+                self._serve(sock)
+            except (OSError, RemoteSignerError) as e:
+                self.logger.debug("signer connection lost", err=str(e))
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _serve(self, sock: socket.socket) -> None:
+        sock.settimeout(None)
+        while not self._stopped.is_set():
+            req = _recv_msg(sock)
+            try:
+                res = self._handle(req)
+            except Exception as e:  # noqa: BLE001 — double-sign etc.
+                res = {"error": str(e)}
+            _send_msg(sock, res)
+
+    def _handle(self, req: dict) -> dict:
+        kind = req.get("type")
+        if kind == "ping":
+            return {"type": "pong"}
+        if kind == "pub_key":
+            pub = self.pv.pub_key()
+            return {
+                "type": "pub_key",
+                "key_type": pub.type_,
+                "pub_key": pub.bytes().hex(),
+            }
+        if kind == "sign_vote":
+            vote = codec.decode_vote(bytes.fromhex(req["vote"]))
+            self.pv.sign_vote(
+                req["chain_id"], vote, sign_extension=req.get("sign_extension", False)
+            )
+            return {"type": "signed_vote", "vote": codec.encode_vote(vote).hex()}
+        if kind == "sign_proposal":
+            proposal = codec.decode_proposal(bytes.fromhex(req["proposal"]))
+            self.pv.sign_proposal(req["chain_id"], proposal)
+            return {
+                "type": "signed_proposal",
+                "proposal": codec.encode_proposal(proposal).hex(),
+            }
+        raise RemoteSignerError(f"unknown request type {kind!r}")

@@ -9,7 +9,6 @@ chain commits to (validator sets, app hash, results hash).
 from __future__ import annotations
 
 from cometbft_tpu.light.client import LightClient
-from cometbft_tpu.light.provider import provider_consensus_params
 from cometbft_tpu.light.store import LightStore
 from cometbft_tpu.light.verifier import TrustOptions
 from cometbft_tpu.state.state import State
@@ -56,7 +55,7 @@ class LightClientStateProvider:
         last = self.client.verify_light_block_at_height(height)
         current = self.client.verify_light_block_at_height(height + 1)
         next_ = self.client.verify_light_block_at_height(height + 2)
-        params = provider_consensus_params(self.client.primary, height + 1)
+        params = self.client.primary.consensus_params(height + 1)
         gdoc = self.genesis_doc
         return State(
             chain_id=self.chain_id,

@@ -115,10 +115,12 @@ class Syncer:
         # merely the first to arrive (reference: SyncAny discoveryTime) —
         # re-polling peers as we wait so fresh snapshots keep arriving
         deadline = time.monotonic() + discovery_time
+        last_poll = 0.0
         while time.monotonic() < deadline and is_running():
-            if rediscover is not None:
+            if rediscover is not None and time.monotonic() - last_poll > 3.0:
+                last_poll = time.monotonic()
                 rediscover()
-            time.sleep(0.5)
+            time.sleep(0.2)
 
         while is_running():
             best = self._best_snapshot()

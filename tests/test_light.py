@@ -145,8 +145,8 @@ class TestLightClient:
         )
         with pytest.raises(ErrLightClientDivergence):
             client.verify_light_block_at_height(3)
-        # the faulty witness was removed
-        assert client.witnesses == []
+        # the disputed header must NOT have entered the trusted store
+        assert client.store.light_block(3) is None
 
     def test_prune(self, chain_node):
         primary = NodeProvider(chain_node)

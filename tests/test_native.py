@@ -1,0 +1,149 @@
+"""C++ native component tests: differential against the Python paths
+(the Python implementations are the correctness oracles)."""
+
+import ctypes
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from cometbft_tpu import native
+
+L = 2**252 + 27742317777372353535851937790883648493
+
+
+@pytest.fixture(scope="module")
+def nlib():
+    lib = native.lib()
+    if lib is None:
+        pytest.skip("native library unavailable")
+    return lib
+
+
+class TestSha512:
+    def test_differential(self, nlib):
+        rng = random.Random(1)
+        cases = [b"", b"a", b"abc", bytes(127), bytes(128), bytes(129)]
+        cases += [rng.randbytes(rng.randrange(0, 5000)) for _ in range(50)]
+        for msg in cases:
+            out = ctypes.create_string_buffer(64)
+            nlib.sha512(msg, len(msg), out)
+            assert out.raw == hashlib.sha512(msg).digest()
+
+
+class TestPacker:
+    def test_differential_mod_l(self, nlib):
+        rng = random.Random(2)
+        n = 300
+        pubs = [rng.randbytes(32) for _ in range(n)]
+        msgs = [rng.randbytes(rng.randrange(0, 300)) for _ in range(n)]
+        sigs = []
+        for i in range(n):
+            r = rng.randbytes(32)
+            if i % 5 == 0:
+                s = (L + rng.randrange(0, 2**120)).to_bytes(32, "little")
+            elif i % 11 == 0:
+                s = bytes(32)  # s = 0 edge
+            else:
+                s = rng.randrange(0, L).to_bytes(32, "little")
+            sigs.append(r + s)
+        off = [0]
+        for m in msgs:
+            off.append(off[-1] + len(m))
+        off_arr = (ctypes.c_int64 * (n + 1))(*off)
+        s_out = ctypes.create_string_buffer(n * 32)
+        m_out = ctypes.create_string_buffer(n * 32)
+        ok_out = ctypes.create_string_buffer(n)
+        rc = nlib.ed25519_pack(
+            b"".join(pubs), b"".join(sigs), b"".join(msgs), off_arr, n,
+            s_out, m_out, ok_out,
+        )
+        assert rc == 0
+        for i in range(n):
+            s = int.from_bytes(sigs[i][32:], "little")
+            assert ok_out.raw[i] == int(s < L)
+            h = (
+                int.from_bytes(
+                    hashlib.sha512(sigs[i][:32] + pubs[i] + msgs[i]).digest(),
+                    "little",
+                )
+                % L
+            )
+            want_m = (L - h) % L
+            assert (
+                int.from_bytes(m_out.raw[i * 32 : (i + 1) * 32], "little")
+                == want_m
+            ), i
+
+    def test_prepare_batch_native_vs_python(self, nlib):
+        """ops.verify.prepare_batch: native path == Python fallback."""
+        from cometbft_tpu.crypto import ed25519_ref as ref
+        from cometbft_tpu.ops import verify as ov
+
+        pubs, msgs, sigs = [], [], []
+        for i in range(40):
+            seed = hashlib.sha256(b"nat%d" % i).digest()
+            pubs.append(ref.pubkey_from_seed(seed))
+            msgs.append(b"native-diff-%d" % i)
+            sigs.append(ref.sign(seed, msgs[-1]))
+        # a structurally broken entry
+        pubs.append(b"short")
+        msgs.append(b"x")
+        sigs.append(b"y" * 64)
+
+        native_arrays, n1, st1 = ov.prepare_batch(pubs, msgs, sigs)
+        os.environ["COMETBFT_TPU_NO_NATIVE"] = "1"
+        try:
+            native._tried = False
+            native._lib = None
+            py_arrays, n2, st2 = ov.prepare_batch(pubs, msgs, sigs)
+        finally:
+            del os.environ["COMETBFT_TPU_NO_NATIVE"]
+            native._tried = False
+            native._lib = None
+        assert n1 == n2
+        assert (st1 == st2).all()
+        for k in native_arrays:
+            assert np.array_equal(
+                np.asarray(native_arrays[k]), np.asarray(py_arrays[k])
+            ), k
+
+
+class TestNativeWAL:
+    def test_native_frames_readable_by_python(self, nlib, tmp_path):
+        from cometbft_tpu.consensus.wal import WAL
+
+        path = str(tmp_path / "nat.wal")
+        w = WAL(path)
+        assert w._nh is not None, "native WAL engine not active"
+        w.write(b"rec-one")
+        w.write_sync(b"rec-two")
+        w.write_end_height(7)
+        w.write(b"rec-after")
+        w.close()
+
+        r = WAL(path)
+        recs = list(r.iter_records())
+        payloads = [rec.payload for rec in recs if rec.kind == 1]
+        assert payloads == [b"rec-one", b"rec-two", b"rec-after"]
+        assert any(rec.end_height == 7 for rec in recs)
+        assert r.replay_after_height(7) == [b"rec-after"]
+        r.close()
+
+    def test_rotation(self, nlib, tmp_path):
+        from cometbft_tpu.consensus.wal import WAL
+
+        path = str(tmp_path / "rot.wal")
+        w = WAL(path, head_size_limit=1024)
+        for i in range(100):
+            w.write(b"payload-%03d" % i * 8)
+        w.close()
+        assert os.path.exists(path + ".000")
+        r = WAL(path, head_size_limit=1024)
+        recs = [rec.payload for rec in r.iter_records()]
+        assert len(recs) == 100
+        assert recs[0] == b"payload-000" * 8
+        assert recs[-1] == b"payload-099" * 8
+        r.close()

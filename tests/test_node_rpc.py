@@ -168,3 +168,41 @@ def test_restart_replays_state(tmp_path):
     _wait_height(n2, h1 + 1, timeout=30)
     assert n2.state_store.load().last_block_height >= h1
     n2.stop()
+
+
+def test_tx_indexing_and_search(node):
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    tx_b = b"idxkey=idxval"
+    tx = base64.b64encode(tx_b).decode()
+    res = _rpc(port, "broadcast_tx_commit", {"tx": tx})
+    assert res["tx_result"]["code"] == 0
+    height = res["height"]
+
+    import hashlib
+
+    tx_hash = hashlib.sha256(tx_b).hexdigest().upper()
+
+    # tx by hash
+    deadline = time.monotonic() + 10
+    got = None
+    while time.monotonic() < deadline:
+        try:
+            got = _rpc(port, "tx", {"hash": tx_hash})
+            break
+        except RuntimeError:
+            time.sleep(0.1)
+    assert got is not None, "tx never indexed"
+    assert got["height"] == height
+    assert base64.b64decode(got["tx"]) == tx_b
+
+    # search by height and by app event attribute
+    by_height = _rpc(port, "tx_search", {"query": f"tx.height={height}"})
+    assert int(by_height["total_count"]) >= 1
+    by_attr = _rpc(port, "tx_search", {"query": "app.key='idxkey'"})
+    assert int(by_attr["total_count"]) == 1
+    assert by_attr["txs"][0]["hash"] == tx_hash
+
+    # block search by height range
+    bs = _rpc(port, "block_search", {"query": f"block.height<={height}"})
+    assert int(bs["total_count"]) >= 1

@@ -1,0 +1,194 @@
+"""Remote signer + secp256k1 tests (reference test model:
+privval/signer_client_test.go, crypto/secp256k1/secp256k1_test.go)."""
+
+import hashlib
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey, priv_key_generate
+from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey, Secp256k1PubKey
+from cometbft_tpu.privval.file_pv import DoubleSignError, FilePV
+from cometbft_tpu.privval.signer import (
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from cometbft_tpu.types.basic import (
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    BlockID,
+    PartSetHeader,
+    Timestamp,
+)
+from cometbft_tpu.types.vote import Proposal, Vote
+
+CHAIN_ID = "signer-test-chain"
+
+
+class TestSecp256k1:
+    def test_sign_verify_roundtrip(self):
+        priv = Secp256k1PrivKey.from_secret(
+            hashlib.sha256(b"secp-test").digest()
+        )
+        pub = priv.pub_key()
+        msg = b"the quick brown fox"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(msg + b"!", sig)
+        assert not pub.verify_signature(msg, bytes(64))
+
+    def test_low_s_enforced(self):
+        priv = Secp256k1PrivKey.generate()
+        pub = priv.pub_key()
+        sig = priv.sign(b"msg")
+        # flip S to the high form: must be rejected
+        _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+        s = int.from_bytes(sig[32:], "big")
+        high = sig[:32] + (_N - s).to_bytes(32, "big")
+        assert not pub.verify_signature(b"msg", high)
+
+    def test_address_is_20_bytes_ripemd(self):
+        priv = Secp256k1PrivKey.generate()
+        assert len(priv.pub_key().address()) == 20
+
+    def test_registry(self):
+        priv = priv_key_generate("secp256k1")
+        pub = priv.pub_key()
+        assert pub.type_ == "secp256k1"
+        from cometbft_tpu.crypto.keys import pub_key_from_type
+
+        again = pub_key_from_type("secp256k1", pub.bytes())
+        assert again.address() == pub.address()
+
+
+def _mkvote(height=5, tag=b"blk") -> Vote:
+    return Vote(
+        type_=PRECOMMIT_TYPE,
+        height=height,
+        round_=0,
+        block_id=BlockID(
+            hash=hashlib.sha256(tag).digest(),
+            part_set_header=PartSetHeader(1, hashlib.sha256(tag + b"p").digest()),
+        ),
+        timestamp=Timestamp(100, 0),
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+@pytest.fixture
+def signer_pair(tmp_path):
+    pv = FilePV(
+        Ed25519PrivKey.from_seed(hashlib.sha256(b"remote-signer").digest()),
+        str(tmp_path / "key.json"),
+        str(tmp_path / "state.json"),
+    )
+    pv.save()
+    endpoint = SignerListenerEndpoint("tcp://127.0.0.1:0")
+    endpoint.start()
+    server = SignerServer(f"tcp://127.0.0.1:{endpoint.bound_port}", pv)
+    server.start()
+    endpoint.wait_for_connection(timeout=10)
+    client = SignerClient(endpoint)
+    yield client, pv
+    server.stop()
+    endpoint.stop()
+
+
+class TestRemoteSigner:
+    def test_pub_key(self, signer_pair):
+        client, pv = signer_pair
+        assert client.pub_key().bytes() == pv.pub_key().bytes()
+
+    def test_sign_vote_matches_local(self, signer_pair):
+        client, pv = signer_pair
+        vote = _mkvote()
+        client.sign_vote(CHAIN_ID, vote)
+        assert vote.signature
+        assert pv.pub_key().verify_signature(
+            vote.sign_bytes(CHAIN_ID), vote.signature
+        )
+
+    def test_double_sign_rejected_remotely(self, signer_pair):
+        client, pv = signer_pair
+        v1 = _mkvote(height=10, tag=b"a")
+        client.sign_vote(CHAIN_ID, v1)
+        v2 = _mkvote(height=10, tag=b"b")  # same HRS, different block
+        with pytest.raises(RemoteSignerError):
+            client.sign_vote(CHAIN_ID, v2)
+
+    def test_sign_proposal(self, signer_pair):
+        client, pv = signer_pair
+        prop = Proposal(
+            height=20,
+            round_=0,
+            pol_round=-1,
+            block_id=BlockID(
+                hash=hashlib.sha256(b"p").digest(),
+                part_set_header=PartSetHeader(1, hashlib.sha256(b"pp").digest()),
+            ),
+            timestamp=Timestamp(50, 0),
+        )
+        client.sign_proposal(CHAIN_ID, prop)
+        assert pv.pub_key().verify_signature(
+            prop.sign_bytes(CHAIN_ID), prop.signature
+        )
+
+    def test_retry_client_survives_reconnect(self, signer_pair):
+        client, pv = signer_pair
+        retry = RetrySignerClient(client, retries=20, wait=0.2)
+        # kill the signer's current connection: the server dials back in
+        with client.endpoint._lock:
+            client.endpoint._conn.close()
+        vote = _mkvote(height=30, tag=b"rc")
+        retry.sign_vote(CHAIN_ID, vote)
+        assert pv.pub_key().verify_signature(
+            vote.sign_bytes(CHAIN_ID), vote.signature
+        )
+
+
+class TestRemoteSignerNode:
+    def test_node_with_remote_signer_produces_blocks(self, tmp_path):
+        """Full node using a remote signer for all consensus signing."""
+        import socket as _socket
+
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+        from tests.test_reactors import _make_node_home, _wait_for
+
+        priv = Ed25519PrivKey.from_seed(hashlib.sha256(b"rsnode").digest())
+        gdoc = GenesisDoc(
+            chain_id="rsnode-chain",
+            genesis_time=Timestamp(0, 0),
+            validators=[GenesisValidator(priv.pub_key(), 10)],
+        )
+        signer_pv = FilePV(
+            priv,
+            str(tmp_path / "signer-key.json"),
+            str(tmp_path / "signer-state.json"),
+        )
+        signer_pv.save()
+
+        # pick a free port for the privval listener up front: the signer
+        # process dials in while Node.__init__ waits for it
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        cfg = _make_node_home(tmp_path, 0, gdoc, priv)
+        cfg.base.priv_validator_laddr = f"tcp://127.0.0.1:{port}"
+        server = SignerServer(f"tcp://127.0.0.1:{port}", signer_pv)
+        server.start()
+        node = Node(cfg)
+        node.start()
+        try:
+            assert _wait_for(lambda: node.consensus.height >= 3, timeout=60)
+        finally:
+            node.stop()
+            server.stop()
