@@ -29,6 +29,28 @@ def _load_config(home: str):
     return cfg
 
 
+
+def _run_until_signal(cleanup_fn) -> int:
+    """Block until SIGINT/SIGTERM, then run cleanup (shared by the daemon
+    commands: start, inspect, light)."""
+    import signal
+    import time as _time
+
+    stop = {"flag": False}
+
+    def _sig(*_a):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop["flag"]:
+            _time.sleep(0.2)
+    finally:
+        cleanup_fn()
+    return 0
+
+
 def cmd_init(args) -> int:
     """Reference: commands/init.go — write config, genesis, node key, privval."""
     from cometbft_tpu.node.nodekey import NodeKey
@@ -222,6 +244,98 @@ def cmd_version(args) -> int:
     return 0
 
 
+
+def cmd_inspect(args) -> int:
+    """Reference: internal/inspect — read-only RPC over the data dir."""
+    from cometbft_tpu.node.inspect import InspectNode
+
+    cfg = _load_config(args.home)
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    node = InspectNode(cfg).serve()
+    print(
+        f"Inspect server listening on {cfg.rpc.laddr} "
+        f"(store height {node.block_store.height()})"
+    )
+    return _run_until_signal(node.close)
+
+
+def cmd_light(args) -> int:
+    """Reference: cmd light — run a light-client RPC proxy daemon."""
+    from cometbft_tpu.light import (
+        SKIPPING,
+        HTTPProvider,
+        LightClient,
+        LightStore,
+        TrustOptions,
+    )
+    from cometbft_tpu.light.proxy import LightProxy
+    from cometbft_tpu.store.kv import SqliteKV
+
+    primary = HTTPProvider(args.chain_id, args.primary)
+    witnesses = [
+        HTTPProvider(args.chain_id, w) for w in (args.witnesses or "").split(",") if w
+    ]
+    if bool(args.trust_height) != bool(args.trust_hash):
+        print("error: --trust-height and --trust-hash must be given together")
+        return 1
+    if args.trust_height:
+        opts = TrustOptions(
+            period_s=args.trust_period,
+            height=args.trust_height,
+            hash=bytes.fromhex(args.trust_hash),
+        )
+    else:
+        lb = primary.light_block(0)
+        opts = TrustOptions(
+            period_s=args.trust_period, height=lb.height, hash=lb.hash()
+        )
+        print(f"WARNING: trusting the primary's latest header blindly "
+              f"(height {lb.height}); pass --trust-height/--trust-hash")
+    os.makedirs(os.path.join(args.home, "light"), exist_ok=True)
+    store = LightStore(SqliteKV(os.path.join(args.home, "light", "trust.db")))
+    client = LightClient(args.chain_id, opts, primary, witnesses, store)
+    proxy = LightProxy(client, args.primary, laddr=args.laddr)
+    proxy.start()
+    print(f"Light client proxy listening on {args.laddr} "
+          f"(trusted height {client.trusted_light_block().height})")
+    return _run_until_signal(proxy.stop)
+
+
+def cmd_confix(args) -> int:
+    """Reference: internal/confix — migrate config.toml to this version."""
+    from cometbft_tpu.config.confix import upgrade
+
+    report = upgrade(args.home, dry_run=args.dry_run)
+    for key in report["carried"]:
+        print(f"carried: {key}")
+    for key in report["unknown"]:
+        print(f"unknown (dropped): {key}")
+    if report["backup"]:
+        print(f"backup written to {report['backup']}")
+    elif args.dry_run:
+        print("dry run: no files written")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """Reference: commands/compact.go — compact the embedded database."""
+    from cometbft_tpu.store.kv import SqliteKV
+
+    cfg = _load_config(args.home)
+    path = os.path.join(cfg.base.home, cfg.base.db_dir, "chain.db")
+    if not os.path.exists(path):
+        print(f"no database at {path}")
+        return 1
+    before = os.path.getsize(path)
+    kv = SqliteKV(path)
+    kv.compact()
+    kv.close()
+    after = os.path.getsize(path)
+    print(f"compacted {path}: {before} -> {after} bytes")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cometbft_tpu", description="TPU-native BFT consensus node"
@@ -263,6 +377,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("rollback", help="roll back one block")
     sp.add_argument("--hard", action="store_true", help="also remove the block")
     sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("inspect", help="read-only RPC over the data dir")
+    sp.add_argument("--rpc-laddr", default="", help="override rpc listen addr")
+    sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("light", help="run a light-client RPC proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True, help="primary node RPC URL")
+    sp.add_argument("--witnesses", default="", help="comma-separated witness RPC URLs")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument("--trust-height", type=int, default=0)
+    sp.add_argument("--trust-hash", default="")
+    sp.add_argument("--trust-period", type=int, default=168 * 3600)
+    sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("confix", help="migrate config.toml to this version")
+    sp.add_argument("--dry-run", action="store_true")
+    sp.set_defaults(fn=cmd_confix)
+
+    sp = sub.add_parser("compact-db", help="compact the embedded database")
+    sp.set_defaults(fn=cmd_compact_db)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 from cometbft_tpu.abci.kvstore import KVStoreApplication
@@ -210,9 +211,49 @@ class Node(BaseService):
         if config.p2p.laddr:
             self._setup_p2p()
 
+        # -- metrics (reference: node/setup.go:139 DefaultMetricsProvider) --
+        from cometbft_tpu.libs.metrics import NodeMetrics
+
+        self.metrics = NodeMetrics(config.instrumentation.namespace)
+        self.metrics_server = None
+
         # -- RPC ------------------------------------------------------------
         self.rpc_server = None
         self._tx_waiter_thread: Optional[threading.Thread] = None
+
+    def _metrics_sampler(self) -> None:
+        """Periodic gauge refresh (reference wires metrics through every
+        constructor; sampling the same state keeps the surface identical
+        without threading a handle into each subsystem)."""
+        m = self.metrics
+        last_height = 0
+        last_time = None
+        while self.is_running:
+            try:
+                rs = self.consensus.rs
+                height = self.block_store.height()
+                m.height.set(height)
+                m.rounds.set(rs.round_)
+                if rs.validators is not None:
+                    m.validators.set(len(rs.validators))
+                    m.validators_power.set(rs.validators.total_voting_power())
+                if height > last_height:
+                    meta = self.block_store.load_block_meta(height)
+                    if meta is not None:
+                        m.num_txs.set(meta.num_txs)
+                        m.block_size.set(meta.block_size)
+                        t = meta.header.time.to_ns() / 1e9
+                        if last_time is not None and height == last_height + 1:
+                            m.block_interval.observe(max(t - last_time, 0.0))
+                        last_time = t
+                    last_height = height
+                if hasattr(self.mempool, "size"):
+                    m.mempool_size.set(self.mempool.size())
+                if self.switch is not None:
+                    m.peers.set(len(self.switch.peers_list()))
+            except Exception:  # noqa: BLE001 — metrics must never kill the node
+                pass
+            time.sleep(2.0)
 
     def _setup_p2p(self) -> None:
         """Create transport, switch, and the protocol reactors
@@ -320,6 +361,17 @@ class Node(BaseService):
     def on_start(self) -> None:
         if self.indexer_service is not None:
             self.indexer_service.start()
+        threading.Thread(
+            target=self._metrics_sampler, name="metrics-sampler", daemon=True
+        ).start()
+        if self.config.instrumentation.prometheus:
+            from cometbft_tpu.libs.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                self.metrics.registry,
+                self.config.instrumentation.prometheus_listen_addr,
+            )
+            self.metrics_server.start()
         if self.config.rpc.laddr:
             from cometbft_tpu.rpc.core import Environment
             from cometbft_tpu.rpc.server import RPCServer
@@ -327,6 +379,24 @@ class Node(BaseService):
             env = Environment(self)
             self.rpc_server = RPCServer(self.config.rpc, env, self.event_bus)
             self.rpc_server.start()
+        self.grpc_server = None
+        self.grpc_privileged_server = None
+        if self.config.grpc.laddr:
+            from cometbft_tpu.rpc.grpc_server import GRPCServer
+
+            self.grpc_server = GRPCServer(
+                self, self.config.grpc.laddr,
+                logger=self.logger.with_(module="grpc"),
+            )
+            self.grpc_server.start()
+        if self.config.grpc.privileged_laddr:
+            from cometbft_tpu.rpc.grpc_server import GRPCServer
+
+            self.grpc_privileged_server = GRPCServer(
+                self, self.config.grpc.privileged_laddr, privileged=True,
+                logger=self.logger.with_(module="grpc-priv"),
+            )
+            self.grpc_privileged_server.start()
         if self.switch is not None:
             # listen, then fix up the advertised address with the bound port
             host, port = self.switch.transport.listen(self.config.p2p.laddr)
@@ -438,6 +508,12 @@ class Node(BaseService):
             self.indexer_service.stop()
         if self._signer_endpoint is not None:
             self._signer_endpoint.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+        for srv in (getattr(self, "grpc_server", None),
+                    getattr(self, "grpc_privileged_server", None)):
+            if srv is not None:
+                srv.stop()
         self.proxy_app.stop()
         self.db.close()
         self.logger.info("node stopped")

@@ -1,0 +1,168 @@
+"""gRPC services (reference: rpc/grpc/server/services/).
+
+Four services on the public endpoint — version, block, block-results —
+plus the privileged pruning service (the data-companion API, reference:
+rpc/grpc/server/services/pruningservice).  Implemented with grpc's
+generic handlers over JSON payloads: same service/method names as the
+reference's proto packages, JSON instead of binary proto on the wire
+(this framework's RPC schema is self-defined; see libs/protoenc).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+from typing import Optional
+
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.version import BLOCK_PROTOCOL, CMT_SEMVER, P2P_PROTOCOL
+
+_VERSION_SVC = "cometbft.services.version.v1.VersionService"
+_BLOCK_SVC = "cometbft.services.block.v1.BlockService"
+_BLOCK_RESULTS_SVC = "cometbft.services.block_results.v1.BlockResultsService"
+_PRUNING_SVC = "cometbft.services.pruning.v1.PruningService"
+
+
+def _json_ser(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _json_deser(raw: bytes):
+    return json.loads(raw.decode()) if raw else {}
+
+
+class GRPCServer:
+    """Reference: rpc/grpc/server/server.go Serve + ServePrivileged."""
+
+    def __init__(self, node, laddr: str, privileged: bool = False, logger=None):
+        import grpc
+
+        self.node = node
+        self.privileged = privileged
+        self.logger = logger or liblog.nop_logger()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        gcfg = node.config.grpc
+        handlers = []
+        if privileged:
+            if gcfg.pruning_service_enabled:
+                handlers.append(self._pruning_service(grpc))
+        else:
+            if gcfg.version_service_enabled:
+                handlers.append(self._version_service(grpc))
+            if gcfg.block_service_enabled:
+                handlers.append(self._block_service(grpc))
+            if gcfg.block_results_service_enabled:
+                handlers.append(self._block_results_service(grpc))
+        for h in handlers:
+            self._server.add_generic_rpc_handlers((h,))
+        addr = laddr.replace("tcp://", "")
+        self.bound_port = self._server.add_insecure_port(addr)
+
+    # -- services ----------------------------------------------------------
+
+    def _unary(self, grpc, fn):
+        return grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=_json_deser, response_serializer=_json_ser
+        )
+
+    def _version_service(self, grpc):
+        def get_version(request, context):
+            return {
+                "node": CMT_SEMVER,
+                "abci": "2.2.0",
+                "p2p": str(P2P_PROTOCOL),
+                "block": str(BLOCK_PROTOCOL),
+            }
+
+        return grpc.method_handlers_generic_handler(
+            _VERSION_SVC, {"GetVersion": self._unary(grpc, get_version)}
+        )
+
+    def _block_service(self, grpc):
+        from cometbft_tpu.rpc.core import _block_json, _block_id_json
+
+        def get_block(request, context):
+            h = int(request.get("height", 0)) or self.node.block_store.height()
+            block = self.node.block_store.load_block(h)
+            meta = self.node.block_store.load_block_meta(h)
+            if block is None or meta is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, f"block {h} not found")
+            return {
+                "block_id": _block_id_json(meta.block_id),
+                "block": _block_json(block),
+            }
+
+        def get_latest_height(request, context):
+            # single-shot variant of the reference's streaming endpoint
+            return {"height": str(self.node.block_store.height())}
+
+        return grpc.method_handlers_generic_handler(
+            _BLOCK_SVC,
+            {
+                "GetByHeight": self._unary(grpc, get_block),
+                "GetLatestHeight": self._unary(grpc, get_latest_height),
+            },
+        )
+
+    def _block_results_service(self, grpc):
+        from cometbft_tpu.rpc.core import Environment
+
+        def get_block_results(request, context):
+            env = Environment(self.node)
+            h = int(request.get("height", 0)) or None
+            try:
+                return env.block_results(h)
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+
+        return grpc.method_handlers_generic_handler(
+            _BLOCK_RESULTS_SVC,
+            {"GetBlockResults": self._unary(grpc, get_block_results)},
+        )
+
+    def _pruning_service(self, grpc):
+        """Data-companion retain heights (reference: pruningservice)."""
+
+        def set_block_retain_height(request, context):
+            h = int(request.get("height", 0))
+            self.node.block_exec._retain.companion_retain = h
+            return {}
+
+        def get_block_retain_height(request, context):
+            r = self.node.block_exec._retain
+            return {
+                "app_retain_height": str(r.app_retain),
+                "pruning_service_retain_height": str(r.companion_retain),
+            }
+
+        return grpc.method_handlers_generic_handler(
+            _PRUNING_SVC,
+            {
+                "SetBlockRetainHeight": self._unary(grpc, set_block_retain_height),
+                "GetBlockRetainHeight": self._unary(grpc, get_block_retain_height),
+            },
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+
+def make_client_channel(target: str):
+    """A channel whose calls use the same JSON codec (for tests/tools)."""
+    import grpc
+
+    return grpc.insecure_channel(target.replace("tcp://", ""))
+
+
+def grpc_call(channel, service: str, method: str, request: dict) -> dict:
+    callable_ = channel.unary_unary(
+        f"/{service}/{method}",
+        request_serializer=_json_ser,
+        response_deserializer=_json_deser,
+    )
+    return callable_(request)

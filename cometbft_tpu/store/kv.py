@@ -35,6 +35,9 @@ class KVStore:
         for k in deletes:
             self.delete(k)
 
+    def compact(self) -> None:
+        """Reclaim space; backends without compaction no-op."""
+
     def flush(self) -> None:
         pass
 
@@ -139,6 +142,12 @@ class SqliteKV(KVStore):
                 "DELETE FROM kv WHERE k = ?", [(k,) for k in deletes]
             )
             self._conn.commit()
+
+    def compact(self) -> None:
+        """Reclaim space (reference: compact-db / RocksDB CompactRange)."""
+        with self._lock:
+            self._conn.commit()
+            self._conn.execute("VACUUM")
 
     def flush(self) -> None:
         with self._lock:

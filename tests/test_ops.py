@@ -1,0 +1,233 @@
+"""Ops parity tests: metrics, gRPC services, inspect, light proxy, confix
+(reference test model: rpc/grpc tests, internal/inspect/inspect_test.go,
+internal/confix tests)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.cmd.main import main as cli_main
+from cometbft_tpu.config import config as cfgmod
+from cometbft_tpu.node.node import Node
+
+CHAIN_ID = "ops-test-chain"
+
+
+@pytest.fixture(scope="module")
+def ops_node(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("ops")
+    home = str(tmp_path / "node")
+    assert cli_main(["--home", home, "init", "--chain-id", CHAIN_ID]) == 0
+    cfg = cfgmod.load_config(home)
+    cfg.base.home = home
+    cfg.base.db_backend = "sqlite"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.privileged_laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.pruning_service_enabled = True
+    cfg.instrumentation.prometheus = True
+    cfg.instrumentation.prometheus_listen_addr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_commit_ms = 50
+    n = Node(cfg)
+    n.start()
+    deadline = time.monotonic() + 60
+    while n.block_store.height() < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert n.block_store.height() >= 3
+    yield n, home
+    n.stop()
+
+
+class TestMetrics:
+    def test_prometheus_exposition(self, ops_node):
+        node, _ = ops_node
+        time.sleep(2.5)  # one sampler pass
+        port = node.metrics_server.bound_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        assert "# TYPE cometbft_consensus_height gauge" in body
+        for line in body.splitlines():
+            if line.startswith("cometbft_consensus_height "):
+                assert float(line.split()[-1]) >= 3
+                break
+        else:
+            raise AssertionError("height gauge missing")
+        assert "cometbft_p2p_peers" in body
+        assert "cometbft_mempool_size" in body
+
+
+class TestGRPC:
+    def test_version_block_and_pruning_services(self, ops_node):
+        from cometbft_tpu.rpc.grpc_server import grpc_call, make_client_channel
+
+        node, _ = ops_node
+        ch = make_client_channel(f"127.0.0.1:{node.grpc_server.bound_port}")
+        ver = grpc_call(
+            ch, "cometbft.services.version.v1.VersionService", "GetVersion", {}
+        )
+        assert ver["block"] == "11"
+
+        blk = grpc_call(
+            ch,
+            "cometbft.services.block.v1.BlockService",
+            "GetByHeight",
+            {"height": "1"},
+        )
+        assert blk["block"]["header"]["height"] == "1"
+        assert blk["block"]["header"]["chain_id"] == CHAIN_ID
+
+        latest = grpc_call(
+            ch, "cometbft.services.block.v1.BlockService", "GetLatestHeight", {}
+        )
+        assert int(latest["height"]) >= 3
+
+        res = grpc_call(
+            ch,
+            "cometbft.services.block_results.v1.BlockResultsService",
+            "GetBlockResults",
+            {"height": "1"},
+        )
+        assert res["height"] == "1"
+
+        # privileged endpoint
+        pch = make_client_channel(
+            f"127.0.0.1:{node.grpc_privileged_server.bound_port}"
+        )
+        svc = "cometbft.services.pruning.v1.PruningService"
+        grpc_call(pch, svc, "SetBlockRetainHeight", {"height": "2"})
+        got = grpc_call(pch, svc, "GetBlockRetainHeight", {})
+        assert got["pruning_service_retain_height"] == "2"
+
+        # the version service must NOT exist on the privileged endpoint
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError):
+            grpc_call(
+                pch,
+                "cometbft.services.version.v1.VersionService",
+                "GetVersion",
+                {},
+            )
+
+
+class TestLightProxy:
+    def test_verified_routes_and_passthrough(self, ops_node):
+        from cometbft_tpu.light import (
+            HTTPProvider,
+            LightClient,
+            LightStore,
+            TrustOptions,
+        )
+        from cometbft_tpu.light.proxy import LightProxy
+        from cometbft_tpu.store.kv import MemKV
+
+        node, _ = ops_node
+        rpc_url = f"http://127.0.0.1:{node.rpc_server.bound_port}"
+        primary = HTTPProvider(CHAIN_ID, rpc_url)
+        lb1 = primary.light_block(1)
+        client = LightClient(
+            CHAIN_ID,
+            TrustOptions(period_s=3600, height=1, hash=lb1.hash()),
+            primary,
+            [],
+            LightStore(MemKV()),
+        )
+        proxy = LightProxy(client, rpc_url, laddr="tcp://127.0.0.1:0")
+        proxy.start()
+        try:
+            def call(method, params=None):
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": 1, "method": method,
+                     "params": params or {}}
+                ).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{proxy.bound_port}/",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                if "error" in doc:
+                    raise RuntimeError(doc["error"])
+                return doc["result"]
+
+            cm = call("commit", {"height": "2"})  # verified through the LC
+            assert cm["signed_header"]["header"]["height"] == "2"
+            blk = call("block", {"height": "2"})  # hash-checked against LC
+            assert blk["block"]["header"]["height"] == "2"
+            vals = call("validators", {"height": "2"})
+            assert vals["total"] == "1"
+            st = call("light_status")
+            assert int(st["trusted_height"]) >= 2
+            # passthrough route
+            status = call("status")
+            assert status["node_info"]["network"] == CHAIN_ID
+        finally:
+            proxy.stop()
+
+
+class TestInspect:
+    def test_inspect_serves_stores_of_stopped_node(self, tmp_path):
+        home = str(tmp_path / "inode")
+        assert cli_main(["--home", home, "init", "--chain-id", "inspect-chain"]) == 0
+        cfg = cfgmod.load_config(home)
+        cfg.base.home = home
+        cfg.base.db_backend = "sqlite"
+        cfg.rpc.laddr = ""
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+        n = Node(cfg)
+        n.start()
+        deadline = time.monotonic() + 60
+        while n.block_store.height() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        n.stop()  # crash/stop the node, then inspect its data dir
+
+        from cometbft_tpu.node.inspect import InspectNode
+
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        inode = InspectNode(cfg).serve()
+        try:
+            port = inode.rpc_server.bound_port
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/block?height=1", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["result"]["block"]["header"]["height"] == "1"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/validators?height=1", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["result"]["total"] == "1"
+        finally:
+            inode.close()
+
+
+class TestConfix:
+    def test_upgrade_carries_values_and_flags_unknown(self, tmp_path):
+        home = str(tmp_path / "cfx")
+        assert cli_main(["--home", home, "init"]) == 0
+        path = os.path.join(home, "config", "config.toml")
+        s = open(path).read()
+        # customize a known key + inject an unknown one
+        s = s.replace('moniker = "anonymous"', 'moniker = "my-node"')
+        s += "\nancient_key = true\n"
+        open(path, "w").write(s)
+
+        from cometbft_tpu.config.confix import upgrade
+
+        report = upgrade(home, dry_run=True)
+        assert "moniker" in report["carried"]
+        assert any("ancient_key" in u for u in report["unknown"])
+
+        report = upgrade(home)
+        assert os.path.exists(report["backup"])
+        cfg = cfgmod.load_config(home)
+        assert cfg.base.moniker == "my-node"
+        assert "ancient_key" not in open(path).read()
