@@ -480,6 +480,14 @@ class ConsensusState(BaseService):
         if rs.proposal_block is None:
             self._sign_add_vote(PREVOTE_TYPE, b"", None)
             return
+        # PBTS timeliness (reference: state.go:1379 proposalIsTimely +
+        # types/proposal.go IsTimely): an untimely proposal gets a nil prevote
+        if self.state.consensus_params.pbts_enabled(height) and not self._proposal_is_timely():
+            self.logger.info(
+                "prevote nil: proposal not timely", height=height, round=round_
+            )
+            self._sign_add_vote(PREVOTE_TYPE, b"", None)
+            return
         # validate the proposal: header checks + app ProcessProposal
         try:
             self.block_exec.validate_block(self.state, rs.proposal_block)
@@ -495,6 +503,20 @@ class ConsensusState(BaseService):
             )
         else:
             self._sign_add_vote(PREVOTE_TYPE, b"", None)
+
+    def _proposal_is_timely(self) -> bool:
+        """Reference: types/proposal.go IsTimely — the proposal timestamp
+        must be within [recv - PRECISION - MSGDELAY, recv + PRECISION];
+        message delay relaxes 10% per round (spec: PBTS adaptive delay)."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_receive_time == 0.0:
+            return True
+        sp = self.state.consensus_params.synchrony
+        precision = sp.precision_ns / 1e9
+        msg_delay = (sp.message_delay_ns / 1e9) * (1.1 ** rs.round_)
+        ts = rs.proposal.timestamp.to_ns() / 1e9
+        recv = rs.proposal_receive_time
+        return ts - precision <= recv <= ts + precision + msg_delay
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
         rs = self.rs
@@ -746,6 +768,7 @@ class ConsensusState(BaseService):
         ):
             raise VoteError("invalid proposal signature")
         rs.proposal = proposal
+        rs.proposal_receive_time = _time.time()
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
             self._drain_orphan_parts()

@@ -130,6 +130,7 @@ class RoundState:
     valid_round: int = -1
     valid_block: Optional[Block] = None
     valid_block_parts: Optional[PartSet] = None
+    proposal_receive_time: float = 0.0  # PBTS: local clock at proposal rx
     votes: Optional[HeightVoteSet] = None
     commit_round: int = -1
     last_commit: Optional[VoteSet] = None

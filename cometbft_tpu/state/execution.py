@@ -410,7 +410,16 @@ class BlockExecutor:
         )
 
     def _prune(self, state: State) -> None:
+        """Prune to the lower of the app's and the data companion's retain
+        heights (reference: state/pruner.go — both consumers must be done
+        with a block before it goes)."""
         retain = self._retain.app_retain
+        if self._retain.companion_retain > 0:
+            retain = (
+                min(retain, self._retain.companion_retain)
+                if retain > 0
+                else self._retain.companion_retain
+            )
         if retain > 0 and retain > self.block_store.base():
             pruned = self.block_store.prune_blocks(retain)
             if pruned and self.logger:

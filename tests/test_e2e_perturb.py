@@ -1,0 +1,166 @@
+"""E2E perturbations: node restart under load + catch-up, and PBTS
+timeliness (reference test model: test/e2e/runner/perturb.go:47-91 and
+internal/consensus/pbts_test.go)."""
+
+import hashlib
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.node.node import Node
+from cometbft_tpu.types.basic import Timestamp
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+from tests.test_reactors import _make_node_home, _wait_for
+
+CHAIN_ID = "perturb-test-chain"
+N_VALS = 4
+
+
+class TestRestartPerturbation:
+    def test_validator_restart_and_catchup(self, tmp_path):
+        """Stop one of four validators mid-chain; the other three keep
+        committing; the restarted node replays its WAL, catches up and
+        follows (reference e2e 'restart' perturbation)."""
+        privs = [
+            Ed25519PrivKey.from_seed(hashlib.sha256(b"pval%d" % i).digest())
+            for i in range(N_VALS)
+        ]
+        gdoc = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=Timestamp(0, 0),
+            validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+        )
+        nodes = []
+        try:
+            cfg0 = _make_node_home(tmp_path, 0, gdoc, privs[0])
+            cfg0.base.db_backend = "sqlite"  # survive restart
+            n0 = Node(cfg0)
+            n0.start()
+            nodes.append(n0)
+            addr0 = n0.switch.transport.listen_addr
+            peer0 = f"{n0.node_key.node_id}@127.0.0.1:{addr0[1]}"
+            cfgs = [cfg0]
+            for i in range(1, N_VALS):
+                cfg = _make_node_home(tmp_path, i, gdoc, privs[i])
+                cfg.base.db_backend = "sqlite"
+                cfg.p2p.persistent_peers = [peer0]
+                n = Node(cfg)
+                n.start()
+                nodes.append(n)
+                cfgs.append(cfg)
+
+            assert _wait_for(
+                lambda: all(n.consensus.height >= 3 for n in nodes), timeout=60
+            )
+
+            # perturb: stop validator 3 (3 of 4 = 30/40 power keeps quorum)
+            victim_cfg = cfgs[3]
+            nodes[3].stop()
+            h_at_stop = max(n.block_store.height() for n in nodes[:3])
+            assert _wait_for(
+                lambda: all(
+                    n.block_store.height() >= h_at_stop + 3 for n in nodes[:3]
+                ),
+                timeout=60,
+            ), "survivors stalled after losing one validator"
+
+            # restart from the same home: WAL replay + blocksync catch-up
+            restarted = Node(victim_cfg)
+            restarted.start()
+            nodes[3] = restarted
+            target = max(n.block_store.height() for n in nodes[:3]) + 2
+            assert _wait_for(
+                lambda: restarted.block_store.height() >= target, timeout=90
+            ), (
+                f"restarted node at {restarted.block_store.height()}, "
+                f"wanted {target}"
+            )
+        finally:
+            for n in nodes:
+                try:
+                    n.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+class TestPBTS:
+    def _net(self, tmp_path, message_delay_ns):
+        from cometbft_tpu.types.params import (
+            ConsensusParams,
+            FeatureParams,
+            SynchronyParams,
+        )
+
+        privs = [
+            Ed25519PrivKey.from_seed(hashlib.sha256(b"pbts%d" % i).digest())
+            for i in range(2)
+        ]
+        params = ConsensusParams(
+            feature=FeatureParams(pbts_enable_height=1),
+            synchrony=SynchronyParams(
+                precision_ns=500_000_000, message_delay_ns=message_delay_ns
+            ),
+        )
+        gdoc = GenesisDoc(
+            chain_id=CHAIN_ID + "-pbts",
+            genesis_time=Timestamp(0, 0),
+            validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+            consensus_params=params,
+        )
+        return privs, gdoc
+
+    def test_pbts_chain_progresses_with_sane_clocks(self, tmp_path):
+        privs, gdoc = self._net(tmp_path, message_delay_ns=15_000_000_000)
+        nodes = []
+        try:
+            cfg0 = _make_node_home(tmp_path, 0, gdoc, privs[0])
+            n0 = Node(cfg0)
+            n0.start()
+            nodes.append(n0)
+            addr0 = n0.switch.transport.listen_addr
+            cfg1 = _make_node_home(tmp_path, 1, gdoc, privs[1])
+            cfg1.p2p.persistent_peers = [
+                f"{n0.node_key.node_id}@127.0.0.1:{addr0[1]}"
+            ]
+            n1 = Node(cfg1)
+            n1.start()
+            nodes.append(n1)
+            assert _wait_for(
+                lambda: all(n.consensus.height >= 3 for n in nodes), timeout=60
+            ), "PBTS-enabled chain failed to progress"
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_untimely_proposal_gets_nil_prevote(self, tmp_path):
+        """Unit-level: a proposal with a far-future timestamp is untimely."""
+        from cometbft_tpu.consensus.state import ConsensusState
+
+        privs, gdoc = self._net(tmp_path, message_delay_ns=1_000_000_000)
+        from cometbft_tpu.types.vote import Proposal
+        from cometbft_tpu.types.basic import BlockID, PartSetHeader
+
+        cfg = _make_node_home(tmp_path, 0, gdoc, privs[0])
+        node = Node(cfg)
+        try:
+            cs = node.consensus
+            cs.rs.proposal = Proposal(
+                height=1,
+                round_=0,
+                pol_round=-1,
+                block_id=BlockID(
+                    hash=b"\x01" * 32,
+                    part_set_header=PartSetHeader(1, b"\x02" * 32),
+                ),
+                timestamp=Timestamp(int(time.time()) + 3600, 0),  # future
+            )
+            cs.rs.proposal_receive_time = time.time()
+            assert not cs._proposal_is_timely()
+            # and a sane timestamp IS timely
+            cs.rs.proposal.timestamp = Timestamp.now()
+            assert cs._proposal_is_timely()
+        finally:
+            node.proxy_app.stop()
+            node.db.close()
