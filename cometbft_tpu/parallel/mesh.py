@@ -79,6 +79,24 @@ def sharded_verify_fn(mesh: Mesh):
     return out
 
 
+def device_put_args(arrays: dict, mesh: Mesh) -> list:
+    """Place packed batch arrays onto the mesh in ``ARG_ORDER``.
+
+    Hands numpy straight to ``jax.device_put`` with the mesh sharding: the
+    arrays must never materialize on the default device first (which may not
+    even be part of the mesh — MULTICHIP_r01 failed exactly this way).
+    """
+    fn_shardings = sharded_verify_fn(mesh)[1]
+    batch_last, vec = fn_shardings
+    return [
+        jax.device_put(
+            np.asarray(arrays[k]),
+            batch_last if np.asarray(arrays[k]).ndim == 2 else vec,
+        )
+        for k in ARG_ORDER
+    ]
+
+
 def pad_to_mesh(arrays: dict, mesh: Mesh) -> dict:
     """Pad the batch axis up to a multiple of the mesh size."""
     n_dev = mesh.devices.size
@@ -106,5 +124,5 @@ def verify_batch_sharded(
     arrays, n, structural = ov.prepare_batch(pubs, msgs, sigs)
     arrays = pad_to_mesh(arrays, mesh)
     fn, _ = sharded_verify_fn(mesh)
-    accept, _ = fn(*(jnp.asarray(arrays[k]) for k in ARG_ORDER))
+    accept, _ = fn(*device_put_args(arrays, mesh))
     return (np.asarray(accept)[: len(structural)] & structural)[:n]

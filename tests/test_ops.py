@@ -130,10 +130,14 @@ class TestLightProxy:
         node, _ = ops_node
         rpc_url = f"http://127.0.0.1:{node.rpc_server.bound_port}"
         primary = HTTPProvider(CHAIN_ID, rpc_url)
-        lb1 = primary.light_block(1)
+        # The shared fixture node may have pruned early heights (the gRPC
+        # pruning-service test sets a retain height); trust the earliest
+        # height that is still available, not a hardcoded 1.
+        trust_h = max(node.block_store.base(), 1)
+        lb1 = primary.light_block(trust_h)
         client = LightClient(
             CHAIN_ID,
-            TrustOptions(period_s=3600, height=1, hash=lb1.hash()),
+            TrustOptions(period_s=3600, height=trust_h, hash=lb1.hash()),
             primary,
             [],
             LightStore(MemKV()),
