@@ -20,6 +20,7 @@ from cometbft_tpu.libs import log as liblog
 from cometbft_tpu.libs import protoenc as pe
 from cometbft_tpu.p2p.conn import ChannelDescriptor
 from cometbft_tpu.p2p.reactor import Reactor
+from cometbft_tpu.state.execution import InvalidBlockError
 from cometbft_tpu.types import codec, validation
 from cometbft_tpu.types.basic import BlockID
 
@@ -201,7 +202,15 @@ class BlocksyncReactor(Reactor):
                 first.header.height,
                 second.last_commit,
             )
-        except validation.CommitVerificationError as e:
+            # The commit only signs the header hash; the block body arrived
+            # from an untrusted peer and keeps its wire-carried hashes
+            # (fill_header_hashes fills empty fields only).  Fully validate
+            # body-vs-header and header-vs-state before applying, exactly as
+            # the reference does (internal/blocksync/reactor.go:546
+            # ValidateBlock) — otherwise a peer could pair the legitimately
+            # signed header with tampered txs/last_commit/evidence.
+            self.block_exec.validate_block(self.state, first)
+        except (validation.CommitVerificationError, InvalidBlockError) as e:
             self.logger.error(
                 "invalid block in blocksync",
                 height=first.header.height,

@@ -32,22 +32,27 @@ def verify(ev, state, state_store, block_store) -> None:
     # The evidence timestamp must match the block time at its height
     # (reference: verify.go:73-81) — otherwise the time half of the expiry
     # test below would be attacker-controlled.  When the block meta is
-    # unavailable (e.g. pruned), fall back to height-age alone, which the
-    # attacker cannot influence.
+    # unavailable (pruned / state-synced node) the timestamp cannot be
+    # authenticated, so the evidence must be REJECTED, exactly as the
+    # reference errors out: accepting it here while meta-holding nodes
+    # reject on time mismatch would let the same proposed block be valid on
+    # one class of nodes and invalid on another — a consensus split.
     meta = block_store.load_block_meta(height)
     age_blocks = state.last_block_height - height
-    if meta is not None:
-        if meta.header.time != ev.time:
-            raise EvidenceInvalidError(
-                "evidence timestamp does not match block time at its height"
-            )
-        age_ns = state.last_block_time.to_ns() - ev.time.to_ns()
-        expired = (
-            age_blocks > params.max_age_num_blocks
-            and age_ns > params.max_age_duration_ns
+    if meta is None:
+        raise EvidenceInvalidError(
+            f"no block meta at evidence height {height}; cannot verify "
+            "evidence time"
         )
-    else:
-        expired = age_blocks > params.max_age_num_blocks
+    if meta.header.time != ev.time:
+        raise EvidenceInvalidError(
+            "evidence timestamp does not match block time at its height"
+        )
+    age_ns = state.last_block_time.to_ns() - ev.time.to_ns()
+    expired = (
+        age_blocks > params.max_age_num_blocks
+        and age_ns > params.max_age_duration_ns
+    )
     if expired:
         raise EvidenceInvalidError(
             f"evidence from height {height} is too old ({age_blocks} blocks)"

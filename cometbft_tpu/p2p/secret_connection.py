@@ -188,6 +188,11 @@ class SecretConnection:
         self._recv_buf = buf[n:]
         return buf[:n]
 
+    def settimeout(self, timeout) -> None:
+        """Passthrough to the underlying socket (where supported)."""
+        if hasattr(self._sock, "settimeout"):
+            self._sock.settimeout(timeout)
+
     def close(self) -> None:
         # shutdown() first: close() alone does not send FIN while another
         # thread is blocked in recv() on the same fd (the in-flight recv

@@ -4,53 +4,69 @@ Reference: privval/ — the NODE runs a ``SignerListenerEndpoint`` (it
 listens; the remote signer dials IN, so the key machine needs no inbound
 ports) and wraps it in a ``SignerClient`` satisfying the PrivValidator
 interface.  The remote side runs ``SignerServer`` around a FilePV.
-Wire format: 4-byte BE length + JSON {type, ...} with votes/proposals as
-hex of their deterministic proto encoding.
 
-A ``RetrySignerClient`` retries transient endpoint errors (reference:
-privval/retry_signer_client.go).
+The TCP link is wrapped in ``SecretConnection`` (X25519 + HKDF +
+ChaCha20-Poly1305 with an Ed25519-signed challenge), exactly as the
+reference wraps tcp privval links (privval/socket_listeners.go:79): the
+signing channel is encrypted, mutually authenticated, and the listener
+pins the first authenticated signer identity — a later connection claiming
+a *different* identity is rejected instead of silently hijacking the
+signer slot.  Messages are JSON {type, ...} with votes/proposals as hex of
+their deterministic proto encoding, framed by the secret connection.
+
+A ``RetrySignerClient`` retries *transport* failures only; errors reported
+by the signer itself (e.g. a double-sign refusal) surface immediately
+(reference: privval/retry_signer_client.go).
 """
 
 from __future__ import annotations
 
 import json
 import socket
-import struct
 import threading
 import time
 from typing import Optional
 
-from cometbft_tpu.crypto.keys import pub_key_from_type
+from cometbft_tpu.crypto.keys import Ed25519PrivKey, pub_key_from_type
 from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.p2p.secret_connection import (
+    SecretConnection,
+    SecretConnectionError,
+)
 from cometbft_tpu.types import codec
 from cometbft_tpu.types.vote import Proposal, Vote
 
 
 class RemoteSignerError(Exception):
-    pass
+    """An error reported by the remote signer itself (e.g. refusal to
+    double-sign).  NOT retried."""
 
 
-def _send_msg(sock: socket.socket, doc: dict) -> None:
-    raw = json.dumps(doc).encode()
-    sock.sendall(struct.pack(">I", len(raw)) + raw)
+class RemoteSignerTransportError(RemoteSignerError):
+    """The signer link failed (connect/io/handshake).  Safe to retry."""
 
 
-def _recv_msg(sock: socket.socket) -> dict:
-    hdr = _recv_exact(sock, 4)
-    (n,) = struct.unpack(">I", hdr)
-    if n > 1 << 20:
-        raise RemoteSignerError(f"oversized signer message {n}")
-    return json.loads(_recv_exact(sock, n).decode())
+def _send_msg(conn: SecretConnection, doc: dict) -> None:
+    conn.write_msg(json.dumps(doc).encode())
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise RemoteSignerError("signer connection closed")
-        buf += chunk
-    return buf
+def _recv_msg(conn: SecretConnection) -> dict:
+    return json.loads(conn.read_msg(max_size=1 << 20).decode())
+
+
+def _derive_link_key(priv_validator) -> Ed25519PrivKey:
+    """Deterministic link identity for a signer: hash of the validator priv
+    key bytes (domain-separated).  Stable across restarts so the listener's
+    identity pinning re-admits a restarted signer; falls back to a fresh
+    key when the privval does not expose raw key bytes."""
+    import hashlib
+
+    priv = getattr(priv_validator, "priv_key", None)
+    raw = priv.bytes() if priv is not None and hasattr(priv, "bytes") else None
+    if not raw:
+        return Ed25519PrivKey.generate()
+    seed = hashlib.sha256(b"cometbft-tpu/privval-link-key" + raw).digest()
+    return Ed25519PrivKey.from_seed(seed)
 
 
 def _parse_laddr(laddr: str) -> tuple[str, int]:
@@ -60,15 +76,32 @@ def _parse_laddr(laddr: str) -> tuple[str, int]:
 
 
 class SignerListenerEndpoint:
-    """Node side: accept ONE signer connection and serialize requests over
-    it (reference: privval/signer_listener_endpoint.go)."""
+    """Node side: accept ONE authenticated signer connection and serialize
+    requests over it (reference: privval/signer_listener_endpoint.go +
+    socket_listeners.go SecretConnection wrapping).
 
-    def __init__(self, laddr: str, timeout: float = 5.0, logger=None):
+    ``conn_key`` is the node's identity for the handshake (an ephemeral key
+    is generated when omitted).  ``expected_signer`` optionally pins the
+    signer's Ed25519 identity up front (32 raw bytes); otherwise the first
+    authenticated identity is pinned and later connections presenting a
+    different identity are rejected.
+    """
+
+    def __init__(
+        self,
+        laddr: str,
+        timeout: float = 5.0,
+        logger=None,
+        conn_key: Optional[Ed25519PrivKey] = None,
+        expected_signer: Optional[bytes] = None,
+    ):
         self.laddr = laddr
         self.timeout = timeout
         self.logger = logger or liblog.nop_logger()
+        self.conn_key = conn_key or Ed25519PrivKey.generate()
+        self._pinned_signer: Optional[bytes] = expected_signer
         self._lock = threading.Lock()
-        self._conn: Optional[socket.socket] = None
+        self._conn: Optional[SecretConnection] = None
         self._listener: Optional[socket.socket] = None
         self._conn_ready = threading.Event()
         self._stopped = False
@@ -88,36 +121,77 @@ class SignerListenerEndpoint:
     def _accept_routine(self) -> None:
         while not self._stopped:
             try:
-                conn, addr = self._listener.accept()
+                raw, addr = self._listener.accept()
             except OSError:
                 return
-            conn.settimeout(self.timeout)
-            with self._lock:
-                if self._conn is not None:
-                    try:
-                        self._conn.close()
-                    except OSError:
-                        pass
-                self._conn = conn
-            self._conn_ready.set()
-            self.logger.info("remote signer connected", addr=str(addr))
+            # handshake on its own thread: an unauthenticated peer that
+            # stalls mid-handshake must not block further accepts (and with
+            # them the legitimate signer's reconnect)
+            threading.Thread(
+                target=self._handshake_routine,
+                args=(raw, addr),
+                name="privval-handshake",
+                daemon=True,
+            ).start()
+
+    def _handshake_routine(self, raw: socket.socket, addr) -> None:
+        raw.settimeout(self.timeout)
+        try:
+            conn = SecretConnection(raw, self.conn_key)
+        except (OSError, SecretConnectionError) as e:
+            self.logger.error(
+                "signer handshake failed", addr=str(addr), err=str(e)
+            )
+            try:
+                raw.close()
+            except OSError:
+                pass
+            return
+        identity = conn.remote_pub_key.bytes()
+        with self._lock:
+            if self._pinned_signer is None:
+                self._pinned_signer = identity
+            elif identity != self._pinned_signer:
+                # an authenticated slot must not be hijackable by a
+                # different identity (ADVICE r1: privval/signer.py:88)
+                self.logger.error(
+                    "rejecting signer with unexpected identity",
+                    addr=str(addr),
+                    got=identity.hex(),
+                    want=self._pinned_signer.hex(),
+                )
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+            self._conn = conn
+        self._conn_ready.set()
+        self.logger.info("remote signer connected", addr=str(addr))
 
     def wait_for_connection(self, timeout: float = 30.0) -> None:
         if not self._conn_ready.wait(timeout=timeout):
-            raise RemoteSignerError("no remote signer connected")
+            raise RemoteSignerTransportError("no remote signer connected")
 
     def request(self, doc: dict) -> dict:
         with self._lock:
             conn = self._conn
             if conn is None:
-                raise RemoteSignerError("no signer connection")
+                raise RemoteSignerTransportError("no signer connection")
             try:
                 _send_msg(conn, doc)
                 res = _recv_msg(conn)
-            except (OSError, RemoteSignerError) as e:
+            except (OSError, SecretConnectionError, ValueError) as e:
                 self._conn = None
                 self._conn_ready.clear()
-                raise RemoteSignerError(f"signer io failed: {e}") from e
+                raise RemoteSignerTransportError(
+                    f"signer io failed: {e}"
+                ) from e
         if res.get("error"):
             raise RemoteSignerError(res["error"])
         return res
@@ -183,7 +257,11 @@ class SignerClient:
 
 
 class RetrySignerClient:
-    """Reference: privval/retry_signer_client.go."""
+    """Reference: privval/retry_signer_client.go.
+
+    Retries only ``RemoteSignerTransportError`` — an error *reported by the
+    signer* (e.g. double-sign refusal) is final and surfaces immediately,
+    matching the reference's transport/remote error split."""
 
     def __init__(self, inner: SignerClient, retries: int = 5, wait: float = 0.2):
         self.inner = inner
@@ -195,7 +273,7 @@ class RetrySignerClient:
         for _ in range(self.retries):
             try:
                 return fn(*args, **kw)
-            except RemoteSignerError as e:
+            except RemoteSignerTransportError as e:
                 last = e
                 time.sleep(self.wait)
         raise last  # type: ignore[misc]
@@ -214,12 +292,30 @@ class RetrySignerClient:
 
 class SignerServer:
     """Remote side: dial the node and answer signing requests from a
-    FilePV (reference: privval/signer_server.go + signer_dialer_endpoint)."""
+    FilePV over a SecretConnection (reference: privval/signer_server.go +
+    signer_dialer_endpoint).
 
-    def __init__(self, addr: str, priv_validator, logger=None):
+    ``conn_key`` is the signer's link identity — the node's listener pins
+    it, so it must survive signer restarts.  By default it is derived
+    deterministically from the validator key (HKDF-style hash of the priv
+    key bytes), so a restarted signer presents the same link identity and
+    is re-admitted instead of locked out.  ``expected_node`` optionally
+    pins the node's identity.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        priv_validator,
+        logger=None,
+        conn_key: Optional[Ed25519PrivKey] = None,
+        expected_node: Optional[bytes] = None,
+    ):
         self.addr = addr
         self.pv = priv_validator
         self.logger = logger or liblog.nop_logger()
+        self.conn_key = conn_key or _derive_link_key(priv_validator)
+        self.expected_node = expected_node
         self._stopped = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -240,26 +336,48 @@ class SignerServer:
             except OSError:
                 time.sleep(0.5)
                 continue
-            self.logger.info("connected to node", addr=self.addr)
             try:
-                self._serve(sock)
-            except (OSError, RemoteSignerError) as e:
-                self.logger.debug("signer connection lost", err=str(e))
-            finally:
+                conn = SecretConnection(sock, self.conn_key)
+                if (
+                    self.expected_node is not None
+                    and conn.remote_pub_key.bytes() != self.expected_node
+                ):
+                    raise SecretConnectionError(
+                        "node identity mismatch: "
+                        f"{conn.remote_pub_key.bytes().hex()}"
+                    )
+            except (OSError, SecretConnectionError) as e:
+                self.logger.error("node handshake failed", err=str(e))
                 try:
                     sock.close()
                 except OSError:
                     pass
+                time.sleep(0.5)
+                continue
+            self.logger.info("connected to node", addr=self.addr)
+            try:
+                self._serve(conn)
+            except (OSError, SecretConnectionError, ValueError) as e:
+                self.logger.debug("signer connection lost", err=str(e))
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            # backoff before redialing: a connection the listener accepted
+            # and then closed (e.g. identity rejected) must not busy-loop
+            # full X25519 handshakes against it
+            self._stopped.wait(0.5)
 
-    def _serve(self, sock: socket.socket) -> None:
-        sock.settimeout(None)
+    def _serve(self, conn: SecretConnection) -> None:
+        conn.settimeout(None)
         while not self._stopped.is_set():
-            req = _recv_msg(sock)
+            req = _recv_msg(conn)
             try:
                 res = self._handle(req)
             except Exception as e:  # noqa: BLE001 — double-sign etc.
                 res = {"error": str(e)}
-            _send_msg(sock, res)
+            _send_msg(conn, res)
 
     def _handle(self, req: dict) -> dict:
         kind = req.get("type")

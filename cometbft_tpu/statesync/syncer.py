@@ -92,6 +92,10 @@ class Syncer:
                 or active.format != format_
             ):
                 return
+            if index < 0 or index >= active.chunks:
+                # out-of-range chunks from a malicious peer must not grow
+                # _chunks without bound (ADVICE r1: statesync/syncer.py:94)
+                return
             if index not in self._chunks:
                 self._chunks[index] = chunk
                 self._chunk_event.set()

@@ -150,8 +150,31 @@ class TestEvidencePool:
         block_store = BlockStore(db)
         state = state_from_genesis(gdoc)
         state_store.save(state)  # saves validators for heights 1,2
-        pool = EvidencePool(db, state_store, block_store)
         valset = state.validators
+        # Evidence verification authenticates the evidence timestamp against
+        # the block meta at its height — store the height-1 block the
+        # evidence claims to be from (time must match _dupe_evidence).
+        from cometbft_tpu.types.block import (
+            Block,
+            ConsensusVersion,
+            Data,
+            Header,
+            empty_commit,
+        )
+
+        header = Header(
+            version=ConsensusVersion(block=11),
+            chain_id=CHAIN_ID,
+            height=1,
+            time=Timestamp(100, 0),
+            last_block_id=BlockID(),
+            validators_hash=valset.hash(),
+        )
+        block = Block(
+            header=header, data=Data(txs=[]), last_commit=empty_commit()
+        )
+        block_store.save_block(block, block.make_part_set(), empty_commit())
+        pool = EvidencePool(db, state_store, block_store)
         return privs, state, pool, valset
 
     def test_add_pending_commit_lifecycle(self):

@@ -150,6 +150,65 @@ class TestRemoteSigner:
             vote.sign_bytes(CHAIN_ID), vote.signature
         )
 
+    def test_retry_client_does_not_retry_signer_refusal(self, signer_pair):
+        """A double-sign refusal is a signer-reported error: it must surface
+        immediately, not after retries*wait of pointless reconnect attempts
+        (reference: retry_signer_client.go transport/remote split)."""
+        client, pv = signer_pair
+        retry = RetrySignerClient(client, retries=50, wait=1.0)
+        v1 = _mkvote(height=40, tag=b"x")
+        retry.sign_vote(CHAIN_ID, v1)
+        v2 = _mkvote(height=40, tag=b"y")  # same HRS, different block
+        t0 = time.monotonic()
+        with pytest.raises(RemoteSignerError):
+            retry.sign_vote(CHAIN_ID, v2)
+        # would take >= 50 s if the refusal were retried
+        assert time.monotonic() - t0 < 5.0
+
+    def test_different_identity_cannot_hijack_signer_slot(self, signer_pair):
+        """The listener pins the first authenticated signer identity; a new
+        inbound connection with a different link key must be rejected and
+        must not replace the active connection (ADVICE r1)."""
+        client, pv = signer_pair
+        intruder = SignerServer(
+            f"tcp://127.0.0.1:{client.endpoint.bound_port}",
+            pv,
+            conn_key=Ed25519PrivKey.from_seed(
+                hashlib.sha256(b"intruder-link").digest()
+            ),  # different link identity than the pinned signer
+        )
+        intruder.start()
+        time.sleep(1.0)  # let the intruder dial in and be rejected
+        try:
+            # legit connection still serves requests
+            vote = _mkvote(height=50, tag=b"pin")
+            client.sign_vote(CHAIN_ID, vote)
+            assert pv.pub_key().verify_signature(
+                vote.sign_bytes(CHAIN_ID), vote.signature
+            )
+        finally:
+            intruder.stop()
+
+    def test_restarted_signer_readmitted(self, signer_pair):
+        """A restarted signer derives the same link key from its validator
+        key, so identity pinning re-admits it instead of locking it out."""
+        client, pv = signer_pair
+        restarted = SignerServer(
+            f"tcp://127.0.0.1:{client.endpoint.bound_port}", pv
+        )
+        restarted.start()
+        time.sleep(1.0)  # takes over the slot with the pinned identity
+        try:
+            vote = _mkvote(height=60, tag=b"rstrt")
+            RetrySignerClient(client, retries=20, wait=0.2).sign_vote(
+                CHAIN_ID, vote
+            )
+            assert pv.pub_key().verify_signature(
+                vote.sign_bytes(CHAIN_ID), vote.signature
+            )
+        finally:
+            restarted.stop()
+
 
 class TestRemoteSignerNode:
     def test_node_with_remote_signer_produces_blocks(self, tmp_path):

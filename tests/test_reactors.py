@@ -234,3 +234,116 @@ class TestBlocksync:
             ), "joiner does not follow live consensus after blocksync"
         finally:
             joiner.stop()
+
+
+class TestBlocksyncBodyValidation:
+    """A malicious peer can pair a legitimately signed header with a
+    tampered body — the commit only covers the header hash.  Blocksync must
+    fully validate the block before applying (ADVICE r1 high; reference:
+    internal/blocksync/reactor.go:546 ValidateBlock)."""
+
+    def _mk_signed_block(self, state, privs, height, last_block_id, last_commit):
+        from cometbft_tpu.state.execution import consensus_params_hash
+        from cometbft_tpu.types.basic import (
+            PRECOMMIT_TYPE,
+            BlockID,
+        )
+        from cometbft_tpu.types.block import (
+            Block,
+            ConsensusVersion,
+            Data,
+            Header,
+        )
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types.vote_set import VoteSet
+
+        vals = state.validators
+        header = Header(
+            version=ConsensusVersion(11, state.version_app),
+            chain_id=state.chain_id,
+            height=height,
+            time=Timestamp(1700000000 + height, 0),
+            last_block_id=last_block_id,
+            validators_hash=vals.hash(),
+            next_validators_hash=state.next_validators.hash(),
+            consensus_hash=consensus_params_hash(state.consensus_params),
+            app_hash=state.app_hash,
+            last_results_hash=state.last_results_hash,
+            proposer_address=vals.get_proposer().address,
+        )
+        block = Block(
+            header=header,
+            data=Data(txs=[b"tx-%d" % height]),
+            last_commit=last_commit,
+        )
+        ps = block.make_part_set()
+        bid = BlockID(hash=block.hash(), part_set_header=ps.header)
+        vs = VoteSet(state.chain_id, height, 0, PRECOMMIT_TYPE, vals)
+        for p in privs:
+            addr = p.pub_key().address()
+            idx = vals.get_by_address(addr)[0]
+            v = Vote(
+                type_=PRECOMMIT_TYPE,
+                height=height,
+                round_=0,
+                block_id=bid,
+                timestamp=Timestamp(1700000000 + height, 1),
+                validator_address=addr,
+                validator_index=idx,
+            )
+            v.signature = p.sign(v.sign_bytes(state.chain_id))
+            vs.add_vote(v)
+        return block, bid, vs.make_commit()
+
+    def test_tampered_body_banned_not_applied(self):
+        from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+        from cometbft_tpu.state.execution import BlockExecutor
+        from cometbft_tpu.state.state import state_from_genesis
+        from cometbft_tpu.types.basic import BlockID
+        from cometbft_tpu.types.block import empty_commit
+
+        privs = [
+            Ed25519PrivKey.from_seed(hashlib.sha256(b"bsv%d" % i).digest())
+            for i in range(4)
+        ]
+        gdoc = GenesisDoc(
+            chain_id="bs-body-chain",
+            genesis_time=Timestamp(0, 0),
+            validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+        )
+        state = state_from_genesis(gdoc)
+        b1, bid1, c1 = self._mk_signed_block(
+            state, privs, 1, BlockID(), empty_commit()
+        )
+        # block 2 only matters for its last_commit over block 1
+        b2 = type(b1)(
+            header=b1.header, data=b1.data, last_commit=c1, evidence=[]
+        )
+
+        # tamper block 1's body AFTER signing; wire-carried header hashes
+        # stay those of the original body (fill_header_hashes fills only
+        # empty fields, like a decode does)
+        b1.data.txs = [b"forged-tx"]
+
+        class FakePool:
+            def __init__(self):
+                self.redone = []
+
+            def peek_two_blocks(self):
+                return b1, b2, "peer1", "peer2"
+
+            def redo_request(self, h):
+                self.redone.append(h)
+
+        class ExplodingStore:
+            def height(self):
+                return 0
+
+            def save_block(self, *a, **k):
+                raise AssertionError("tampered block must not be saved")
+
+        exec_ = BlockExecutor(None, None, None, None)
+        r = BlocksyncReactor(state, exec_, ExplodingStore(), enabled=True)
+        r.pool = FakePool()
+        assert r._process_blocks() is True  # handled (rejected + redo)
+        assert r.pool.redone == [1, 2]
