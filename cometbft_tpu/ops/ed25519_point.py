@@ -1,10 +1,15 @@
 """Batched edwards25519 point arithmetic in JAX (extended coordinates).
 
-A batch of points is a 4-tuple (X, Y, Z, T) of (20, B) limb arrays (see
-``fe25519``), T = XY/Z.  Formulas are the unified/complete ones from
-RFC 8032 section 5.1.4 — complete for *all* curve points (including the small
--order points that ZIP-215 verification must handle), so every step of the
-scalar-multiplication ladder is branch-free: ideal for XLA.
+A batch of points is four ``fe25519.F`` limb arrays (X, Y, Z, T), T = XY/Z.
+Formulas are the unified/complete ones from RFC 8032 section 5.1.4 —
+complete for *all* curve points including the small-order points ZIP-215
+verification must handle, so every ladder step is branch-free.
+
+The double-base scalar multiplication s*B + m*A is a radix-16 Straus walk:
+64 digit positions, each 4 doublings plus one complete addition from a
+per-lane table {0..15}*A and one mixed (niels) addition from a 16-entry
+*constant* table {0..15}*B — the constant-table lookup is a small exact
+f32 matmul (one-hot x table) that rides the MXU instead of the VPU.
 
 Reference behavior being reproduced: the double-base scalar multiplication
 inside curve25519-voi batch verification (crypto/ed25519/ed25519.go:189-222
@@ -13,59 +18,77 @@ pulls it in; SURVEY.md §3.4 maps the call stack).
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from cometbft_tpu.ops import fe25519 as fe
+from cometbft_tpu.crypto import ed25519_ref as ref
+
+D_INT = ref.D
+D2_INT = ref.D2
+BASE_X = ref.BASE[0]
+BASE_Y = ref.BASE[1]
+
+NPOS = 64  # radix-16 digit positions covering 256 scalar bits
+WINDOW = 16
 
 
 class PointBatch(NamedTuple):
-    x: jnp.ndarray
-    y: jnp.ndarray
-    z: jnp.ndarray
-    t: jnp.ndarray
+    x: fe.F
+    y: fe.F
+    z: fe.F
+    t: fe.F
 
 
-# Curve constants as python ints (derived, not copied: standard edwards25519).
-D_INT = (-121665 * pow(121666, fe.P_INT - 2, fe.P_INT)) % fe.P_INT
-D2_INT = 2 * D_INT % fe.P_INT
+class TablePoint(NamedTuple):
+    """Extended point with precomputed 2d*T (for the complete addition)."""
 
-_BY = 4 * pow(5, fe.P_INT - 2, fe.P_INT) % fe.P_INT
-# Recover base-point x with even parity (RFC 8032 5.1).
-_u = (_BY * _BY - 1) % fe.P_INT
-_v = (D_INT * _BY * _BY + 1) % fe.P_INT
-_x = (_u * pow(_v, 3, fe.P_INT)) % fe.P_INT * pow(
-    (_u * pow(_v, 7, fe.P_INT)) % fe.P_INT, (fe.P_INT - 5) // 8, fe.P_INT
-) % fe.P_INT
-if (_v * _x * _x - _u) % fe.P_INT != 0:
-    _x = _x * pow(2, (fe.P_INT - 1) // 4, fe.P_INT) % fe.P_INT
-if _x & 1:
-    _x = fe.P_INT - _x
-BASE_X, BASE_Y = _x, _BY
+    x: fe.F
+    y: fe.F
+    z: fe.F
+    t2d: fe.F
+
+
+def _red(f: fe.F) -> fe.F:
+    """Carry + widen bounds to exactly the RED hull (stable scan carries)."""
+    f = fe.carry(f)
+    return fe.F(f.v, fe.RED_LO, fe.RED_HI)
 
 
 def identity(batch: int) -> PointBatch:
-    zero = jnp.zeros((fe.NLIMBS, batch), jnp.int32)
-    one = jnp.broadcast_to(fe.const(1), (fe.NLIMBS, batch))
+    zero = fe.F(jnp.zeros((fe.NLIMBS, batch), jnp.int32), 0, 0)
+    one = fe.const(1, batch)
     return PointBatch(zero, one, one, zero)
 
 
-def base_point(batch: int) -> PointBatch:
-    x = jnp.broadcast_to(fe.const(BASE_X), (fe.NLIMBS, batch))
-    y = jnp.broadcast_to(fe.const(BASE_Y), (fe.NLIMBS, batch))
-    one = jnp.broadcast_to(fe.const(1), (fe.NLIMBS, batch))
-    t = jnp.broadcast_to(fe.const(BASE_X * BASE_Y % fe.P_INT), (fe.NLIMBS, batch))
-    return PointBatch(x, y, one, t)
+def negate(p: PointBatch) -> PointBatch:
+    return PointBatch(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
 
 
-def add(p: PointBatch, q: PointBatch) -> PointBatch:
+def double(p: PointBatch, need_t: bool = True) -> PointBatch:
+    """dbl-2008-hwcd (complete on the twisted curve); 4 squares + 3-4 muls."""
+    a = fe.square(p.x)
+    b = fe.square(p.y)
+    c = fe.mul_small(fe.square(p.z), 2)
+    h = fe.add(a, b)
+    e = fe.sub(h, fe.square(fe.add(p.x, p.y)))
+    g = fe.sub(a, b)
+    f = fe.add(c, g)
+    t = fe.mul(e, h) if need_t else fe.F(e.v[:1] * 0 + 0, 0, 0)
+    return PointBatch(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), t)
+
+
+def add_table(p: PointBatch, q: TablePoint) -> PointBatch:
+    """Complete extended addition with q.t pre-scaled by 2d; 8 muls."""
     a = fe.mul(fe.sub(p.y, p.x), fe.sub(q.y, q.x))
     b = fe.mul(fe.add(p.y, p.x), fe.add(q.y, q.x))
-    c = fe.mul(fe.mul(p.t, q.t), jnp.broadcast_to(fe.const(D2_INT), p.t.shape))
-    d = fe.mul(fe.add(p.z, p.z), q.z)
+    c = fe.mul(p.t, q.t2d)
+    d = fe.mul_small(fe.mul(p.z, q.z), 2)
     e = fe.sub(b, a)
     f = fe.sub(d, c)
     g = fe.add(d, c)
@@ -73,59 +96,23 @@ def add(p: PointBatch, q: PointBatch) -> PointBatch:
     return PointBatch(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
 
 
-def double(p: PointBatch) -> PointBatch:
-    a = fe.square(p.x)
-    b = fe.square(p.y)
-    c = fe.add(fe.square(p.z), fe.square(p.z))
-    h = fe.add(a, b)
-    e = fe.sub(h, fe.square(fe.add(p.x, p.y)))
-    g = fe.sub(a, b)
-    f = fe.add(c, g)
+def add(p: PointBatch, q: PointBatch) -> PointBatch:
+    """Complete extended + extended (computes 2d*T2 on the fly)."""
+    t2d = fe.mul(q.t, fe.const(D2_INT))
+    return add_table(p, TablePoint(q.x, q.y, q.z, t2d))
+
+
+def madd_niels(p: PointBatch, ypx: fe.F, ymx: fe.F, t2d: fe.F) -> PointBatch:
+    """Mixed addition with an affine niels point (y+x, y-x, 2d*x*y); 7 muls."""
+    a = fe.mul(fe.sub(p.y, p.x), ymx)
+    b = fe.mul(fe.add(p.y, p.x), ypx)
+    c = fe.mul(p.t, t2d)
+    d = fe.mul_small(p.z, 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
     return PointBatch(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
-
-
-def negate(p: PointBatch) -> PointBatch:
-    return PointBatch(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
-
-
-def select4(sel: jnp.ndarray, tbl: list[PointBatch]) -> PointBatch:
-    """Branch-free 4-way table lookup: sel (B,) int32 in {0..3}.
-
-    Implemented as a one-hot weighted sum — no gather, pure VPU mul/add,
-    constant-time across lanes."""
-    coords = []
-    for k in range(4):
-        oh = (sel == k).astype(jnp.int32)[None, :]  # (1, B)
-        coords.append(tuple(c * oh for c in tbl[k]))
-    out = tuple(
-        coords[0][i] + coords[1][i] + coords[2][i] + coords[3][i] for i in range(4)
-    )
-    return PointBatch(*out)
-
-
-def double_base_scalar_mul(
-    bits_s: jnp.ndarray, bits_m: jnp.ndarray, a: PointBatch
-) -> PointBatch:
-    """Compute s*B + m*A jointly (Straus/Shamir ladder).
-
-    bits_s, bits_m: (253, B) int32, MSB first.  Per bit: one doubling and one
-    complete addition of a 4-entry table {O, B, A, B+A} selected branch-free.
-    """
-    batch = bits_s.shape[1]
-    tbl = [identity(batch), base_point(batch), a, add(base_point(batch), a)]
-
-    def body(p, bits):
-        bs, bm = bits
-        p = double(p)
-        p = add(p, select4(bs + 2 * bm, tbl))
-        return p, None
-
-    # Tie the initial carry's sharding variance to the (varying) input point
-    # so scan carry types match under shard_map.
-    zero = a.x - a.x
-    p0 = PointBatch(*(c + zero for c in identity(batch)))
-    p, _ = lax.scan(body, p0, (bits_s, bits_m))
-    return p
 
 
 def is_identity(p: PointBatch) -> jnp.ndarray:
@@ -133,22 +120,142 @@ def is_identity(p: PointBatch) -> jnp.ndarray:
     return fe.is_zero(p.x) & fe.eq(p.y, p.z)
 
 
-def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
+# ---------------------------------------------------------------------------
+# ZIP-215 decompression.
+# ---------------------------------------------------------------------------
+
+def decompress(y: fe.F, sign: jnp.ndarray):
     """ZIP-215 point decompression on-device.
 
-    y_limbs: (20, B) limbs of the 255-bit y field (sign bit already stripped;
-    non-canonical y >= p accepted).  sign: (B,) int32 in {0, 1}.
+    ``y``: F of the 255-bit y field (sign bit stripped; non-canonical
+    y >= p accepted).  ``sign``: (B,) int32 in {0, 1}.
     Returns (ok, PointBatch).
     """
-    one = jnp.broadcast_to(fe.const(1), y_limbs.shape)
-    y2 = fe.square(y_limbs)
+    one = fe.const(1, y.v.shape[1])
+    y2 = fe.square(y)
     u = fe.sub(y2, one)
-    v = fe.add(fe.mul(y2, jnp.broadcast_to(fe.const(D_INT), y2.shape)), one)
+    v = fe.add(fe.mul(y2, fe.const(D_INT)), one)
     ok, x = fe.sqrt_ratio(u, v)
-    x = fe.freeze(x)
+    xf = fe.F(fe.freeze(x), 0, fe.MASK)
+    odd = (xf.v[0] & 1) == 1
     # Normalize to the even root, then apply the sign bit (-0 stays 0:
     # non-canonical sign encodings are accepted, matching ZIP-215).
-    odd = (x[0] & 1) == 1
-    x = fe.select(odd, fe.neg(x), x)
+    x = fe.select(odd, fe.neg(xf), xf)
     x = fe.select(sign == 1, fe.neg(x), x)
-    return ok, PointBatch(x, y_limbs, one, fe.mul(x, y_limbs))
+    return ok, PointBatch(x, y, one, fe.mul(x, y))
+
+
+# ---------------------------------------------------------------------------
+# Tables.
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=1)
+def _niels_base_table() -> np.ndarray:
+    """(3*20, 16) f32: niels triples (y+x, y-x, 2dxy) of k*B, k = 0..15.
+
+    Baked on host from the pure-python oracle; laid out for one exact f32
+    dot_general against a one-hot digit matrix."""
+    out = np.zeros((3, fe.NLIMBS, WINDOW), np.float32)
+    P = fe.P_INT
+    for k in range(WINDOW):
+        if k == 0:
+            x, yy = 0, 1  # identity: niels (1, 1, 0)
+        else:
+            X, Y, Z, _ = ref.pt_mul(k, ref.BASE)
+            zi = pow(Z, P - 2, P)
+            x, yy = X * zi % P, Y * zi % P
+        out[0, :, k] = fe.limbs_of_int((yy + x) % P)
+        out[1, :, k] = fe.limbs_of_int((yy - x) % P)
+        out[2, :, k] = fe.limbs_of_int(2 * D_INT * x % P * yy % P)
+    return out.reshape(3 * fe.NLIMBS, WINDOW)
+
+
+def select_base(digit: jnp.ndarray):
+    """digit (B,) in [0,16) -> niels triple of digit*B via exact f32 matmul
+    (constant table is the shared operand -> MXU, not VPU)."""
+    onehot = (digit[None, :] == jnp.arange(WINDOW, dtype=jnp.int32)[:, None])
+    tbl = jnp.asarray(_niels_base_table())
+    sel = lax.dot_general(
+        tbl,
+        onehot.astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+    )  # (60, B) exact: one nonzero per column, values < 2^13 < 2^24
+    sel = sel.astype(jnp.int32)
+    n = fe.NLIMBS
+    mk = lambda i: fe.F(sel[i * n : (i + 1) * n], 0, fe.MASK)
+    return mk(0), mk(1), mk(2)
+
+
+def build_table_a(a: PointBatch):
+    """Per-lane table {0..15}*A as stacked arrays (16, 20, B) per coord,
+    with T pre-scaled by 2d."""
+    batch = a.x.v.shape[1]
+    entries = [identity(batch), a]
+    for _ in range(2, WINDOW):
+        entries.append(add(entries[-1], a))
+    d2 = fe.const(D2_INT)
+    coords = []
+    for getter in (
+        lambda p: p.x,
+        lambda p: p.y,
+        lambda p: p.z,
+        lambda p: fe.mul(p.t, d2),
+    ):
+        fs = [_red(getter(p)) for p in entries]
+        coords.append(jnp.stack([f.v for f in fs]))  # (16, 20, B)
+    return tuple(coords)
+
+
+def select_table_a(table, digit: jnp.ndarray) -> TablePoint:
+    """Branch-free per-lane 16-way select: one-hot weighted sum on the VPU
+    (the table differs per lane, so there is no shared operand for the
+    MXU).  Values stay int32 exact."""
+    onehot = (
+        digit[None, :] == jnp.arange(WINDOW, dtype=jnp.int32)[:, None]
+    ).astype(jnp.int32)  # (16, B)
+    outs = []
+    for c in table:  # (16, 20, B)
+        acc = c[0] * onehot[0][None, :]
+        for k in range(1, WINDOW):
+            acc = acc + c[k] * onehot[k][None, :]
+        outs.append(fe.F(acc, fe.RED_LO, fe.RED_HI))
+    return TablePoint(*outs)
+
+
+# ---------------------------------------------------------------------------
+# The ladder.
+# ---------------------------------------------------------------------------
+
+def double_base_scalar_mul(
+    dig_s: jnp.ndarray, dig_m: jnp.ndarray, a: PointBatch
+) -> PointBatch:
+    """Compute s*B + m*A jointly (radix-16 Straus).
+
+    dig_s, dig_m: (64, B) int32 digits in [0,16), most significant first.
+    Per position: 4 doublings, one complete add of {0..15}*A (per-lane
+    table), one niels add of {0..15}*B (constant table).
+    """
+    batch = dig_s.shape[1]
+    table_a = build_table_a(a)
+
+    def norm(p: PointBatch) -> PointBatch:
+        return PointBatch(*(_red(c) for c in p))
+
+    def body(p, digs):
+        ds, dm = digs
+        p = double(p, need_t=False)
+        p = double(p, need_t=False)
+        p = double(p, need_t=False)
+        p = double(p, need_t=True)
+        p = add_table(p, select_table_a(table_a, dm))
+        ypx, ymx, t2d = select_base(ds)
+        p = madd_niels(p, ypx, ymx, t2d)
+        return norm(p), None
+
+    p0 = norm(identity(batch))
+    # tie sharding variance of the initial carry to the (varying) input so
+    # scan carry types match under shard_map
+    zero = a.x.v - a.x.v
+    p0 = PointBatch(*(fe.F(c.v + zero, c.lo, c.hi) for c in p0))
+    p, _ = lax.scan(body, p0, (dig_s, dig_m))
+    return p

@@ -1,246 +1,433 @@
-"""Batched GF(2^255-19) field arithmetic in JAX, designed for TPU.
+"""Batched GF(2^255-19) field arithmetic in JAX, designed for the TPU VPU.
 
 Layout: a batch of field elements is an int32 array of shape ``(20, B)`` —
-20 little-endian limbs of 13 bits each (values in ``[0, 2^13)``), batch last.
-Limbs-first puts the batch on the TPU lane dimension (128-wide VPU lanes), so
-every limb operation is a full-width vector op; the 20-limb axis lives on
-sublanes.
+20 little-endian limbs of 13 bits each, batch on the TPU lane dimension.
+Limbs are SIGNED and lazily reduced: a "reduced" element has limbs in
+[-4704, 4703] (the fixpoint of the rounding-shift carry below); sums and
+differences of reduced elements are valid unreduced elements and feed the
+multiplier directly — no carry after add/sub.
 
-Why 13-bit limbs: schoolbook products ``a_i * b_j`` are < 2^26 and a 39-column
-accumulation stays < 20 * 2^26 < 2^31, so the whole multiplier runs in native
-int32 with no 64-bit emulation — the TPU has no fast u64 path.  (The reference
-gets this arithmetic from curve25519-voi's platform assembly; here it is
-re-derived for the TPU's integer units.  Reference seam:
-crypto/ed25519/ed25519.go:189-222.)
+Every element carries *static* per-limb bounds (python ints, zero runtime
+cost) threaded through all ops.  ``mul``/``square`` check the bound product
+against int32 overflow at trace time and auto-insert the minimal number of
+parallel carry steps — the overflow discipline is machine-checked, not
+hand-waved.
 
-Values are kept *partially reduced* (any 13-bit limb pattern, i.e. < 2^260,
-congruent mod p); ``freeze`` produces the canonical representative for
-comparisons and encoding.
+Carries are PARALLEL (a few rounds of shift/mask/rotate over the whole limb
+array), never ``lax.scan``; and there are NO int32 ``dot_general``s and no
+scatters anywhere — measured on the target chip, an int32 matmul runs ~3
+orders of magnitude slower than the VPU elementwise path that replaces it
+(this was the round-1 kernel's actual bottleneck, see VERDICT.md).
+
+Reference behavior being re-derived (not translated): the field layer that
+curve25519-voi supplies to the reference's batch verifier
+(crypto/ed25519/ed25519.go:189-222).
 """
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 NLIMBS = 20
 BITS = 13
-MASK = (1 << BITS) - 1
+BASE = 1 << BITS  # 8192
+HALF = BASE // 2  # rounding offset for the centered carry
+MASK = BASE - 1
 P_INT = 2**255 - 19
-# 2^260 = 2^5 * 2^255 ≡ 32 * 19 (mod p): the fold factor for limb overflow.
+# carry out of limb 19 has weight 2^260 = 2^5 * 2^255 ≡ 32*19 (mod p)
 FOLD = 19 * 32  # 608
-# 2^255 ≡ 19: fold factor for bits 255..259 inside limb 19.
-TOP_FOLD = 19
 
+# Reduced-limb bounds: fixpoint of one carry round (see _carry_intervals).
+RED_LO, RED_HI = -(HALF + FOLD), HALF - 1 + FOLD
+# int32 budget for a 20-term column of products, with headroom for the
+# rounding offset added during carries.
+_I32_LIMIT = 2**31 - 1 - HALF
+
+
+class F(NamedTuple):
+    """A batch of field elements: (20, B) int32 limbs + static bounds."""
+
+    v: jnp.ndarray
+    lo: int
+    hi: int
+
+    @property
+    def absmax(self) -> int:
+        return max(abs(self.lo), abs(self.hi))
+
+
+# lo/hi must be pytree AUX data (static), not leaves: scan/jit carry F values.
+jax.tree_util.register_pytree_node(
+    F,
+    lambda f: ((f.v,), (f.lo, f.hi)),
+    lambda aux, ch: F(ch[0], aux[0], aux[1]),
+)
+
+
+# ---------------------------------------------------------------------------
+# Host helpers (numpy / python ints) — used by tests and constant baking.
+# ---------------------------------------------------------------------------
 
 def limbs_of_int(n: int) -> np.ndarray:
-    """Host helper: python int -> (20,) int32 limb vector."""
-    out = np.zeros(NLIMBS, np.int32)
+    """Python int in [0, 2^260) -> (20,) int32 nonneg limb vector."""
+    out = np.zeros(NLIMBS, np.int64)
     for i in range(NLIMBS):
         out[i] = n & MASK
         n >>= BITS
     assert n == 0, "value does not fit in 20x13 bits"
-    return out
-
-
-def int_of_limbs(x: np.ndarray) -> int:
-    """Host helper: (20,) limbs -> python int (no reduction)."""
-    n = 0
-    for i in reversed(range(NLIMBS)):
-        n = (n << BITS) | int(x[i])
-    return n
-
-
-_P_LIMBS = limbs_of_int(P_INT)
-# 32p expressed so that limb-wise (a + C - b) only dips negative in limb 0,
-# which the signed (floor) carry chain absorbs.  32p = 2^260 - 608.
-_SUB_PAD = np.full(NLIMBS, MASK, np.int32)
-_SUB_PAD[0] = MASK - (2**260 - 1 - (32 * P_INT))
-assert int_of_limbs(_SUB_PAD) == 32 * P_INT
-
-
-def const(n: int, batch: int | None = None) -> jnp.ndarray:
-    """A field constant, shape (20, 1) broadcastable over the batch."""
-    limbs = limbs_of_int(n % P_INT)
-    if batch is None:
-        return jnp.asarray(limbs[:, None], jnp.int32)
-    return jnp.broadcast_to(jnp.asarray(limbs[:, None], jnp.int32), (NLIMBS, batch))
-
-
-def bytes_to_limbs(data: np.ndarray) -> np.ndarray:
-    """Host helper: (B, 32) uint8 little-endian -> (20, B) int32 limbs.
-
-    Takes all 256 bits; callers mask bit 255 (the sign bit) beforehand if
-    needed.  Values >= p are fine — arithmetic is on partially-reduced forms.
-    """
-    bits = np.unpackbits(data, axis=1, bitorder="little").astype(np.int64)  # (B,256)
-    out = np.zeros((NLIMBS, data.shape[0]), np.int64)
-    w = (1 << np.arange(BITS)).astype(np.int64)
-    for i in range(NLIMBS):
-        seg = bits[:, BITS * i : min(BITS * (i + 1), 256)]
-        out[i] = seg @ w[: seg.shape[1]]
     return out.astype(np.int32)
 
 
-def limbs_to_bytes(x: np.ndarray) -> np.ndarray:
-    """Host helper: (20, B) canonical limbs -> (B, 32) uint8 little-endian."""
-    B = x.shape[1]
-    bits = np.zeros((B, 260), np.uint8)
-    for i in range(NLIMBS):
-        v = x[i].astype(np.int64)
-        for j in range(BITS):
-            bits[:, BITS * i + j] = (v >> j) & 1
-    return np.packbits(bits[:, :256], axis=1, bitorder="little")
+def int_of_limbs(x) -> int:
+    """(20,) limbs (any signedness) -> python int (not reduced mod p)."""
+    n = 0
+    for i in reversed(range(NLIMBS)):
+        n = (n << BITS) + int(x[i])
+    return n
+
+
+def const(n: int, batch: int | None = None) -> F:
+    """A field constant, broadcastable over the batch."""
+    limbs = limbs_of_int(n % P_INT)
+    arr = jnp.asarray(limbs[:, None])
+    if batch is not None:
+        arr = jnp.broadcast_to(arr, (NLIMBS, batch))
+    return F(arr, 0, MASK)
+
+
+def zero_like(a: F) -> F:
+    return F(jnp.zeros_like(a.v), 0, 0)
 
 
 # ---------------------------------------------------------------------------
-# Device ops.  All take/return (20, B) int32 with limbs in [0, 2^13).
+# Carry machinery: static interval analysis drives the emitted step count.
 # ---------------------------------------------------------------------------
 
-def _carry_chain(x: jnp.ndarray):
-    """One pass of sequential carry propagation over the leading axis
-    (lax.scan keeps the HLO graph O(1) in the limb count — unrolled chains
-    made the full verify kernel take minutes to compile).  Returns
-    (final_carry, rows) with every row in [0, 2^13)."""
-
-    def step(carry, row):
-        row = row + carry
-        c = row >> BITS  # arithmetic shift: floor semantics
-        return c, row - (c << BITS)
-
-    return lax.scan(step, jnp.zeros_like(x[0]), x)
+def _carry_interval_step(lo: int, hi: int) -> tuple[int, int]:
+    """One parallel carry round in interval arithmetic (all limbs pooled,
+    including limb 0's x608 fold — pessimistic but sound)."""
+    c_lo = (lo + HALF) >> BITS
+    c_hi = (hi + HALF) >> BITS
+    in_lo = min(c_lo, FOLD * c_lo, 0)
+    in_hi = max(c_hi, FOLD * c_hi, 0)
+    return -HALF + in_lo, HALF - 1 + in_hi
 
 
-def _carry(x: jnp.ndarray) -> jnp.ndarray:
-    """Signed carry propagation + top fold over a (20, B) array whose limbs
-    may exceed 13 bits (|limb| < 2^30).  Two passes guarantee convergence for
-    the bounds produced by add/sub/mul."""
-    for _ in range(2):
-        carry, rows = _carry_chain(x)
-        x = rows.at[0].add(FOLD * carry)  # 2^260 ≡ 608 (mod p)
-    return x
+def _steps_to_reduce(lo: int, hi: int) -> int:
+    steps = 0
+    while lo < RED_LO or hi > RED_HI:
+        lo, hi = _carry_interval_step(lo, hi)
+        steps += 1
+        assert steps <= 8, "carry interval analysis diverged"
+    return steps
 
 
-def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return _carry(a + b)
+def _carry_once(v: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry round over (20, B): centered remainders, carries
+    move up one limb, the top carry folds into limb 0 with weight 608."""
+    c = (v + HALF) >> BITS  # arithmetic shift: floor((x + 4096)/8192)
+    r = v - (c << BITS)  # in [-4096, 4095]
+    carry_in = jnp.concatenate([FOLD * c[-1:], c[:-1]], axis=0)
+    return r + carry_in
 
 
-def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    pad = jnp.asarray(_SUB_PAD[:, None], jnp.int32)
-    return _carry(a + pad - b)
+def carry(a: F) -> F:
+    """Reduce to the RED fixpoint; emits exactly as many parallel rounds as
+    the static bounds require (0 if already reduced)."""
+    lo, hi, v = a.lo, a.hi, a.v
+    for _ in range(_steps_to_reduce(lo, hi)):
+        v = _carry_once(v)
+        lo, hi = _carry_interval_step(lo, hi)
+    return F(v, max(lo, RED_LO), min(hi, RED_HI))
 
 
-def neg(a: jnp.ndarray) -> jnp.ndarray:
-    pad = jnp.asarray(_SUB_PAD[:, None], jnp.int32)
-    return _carry(pad - a)
+# ---------------------------------------------------------------------------
+# Ring ops.  add/sub are carry-free; mul/square auto-reduce their inputs.
+# ---------------------------------------------------------------------------
+
+def add(a: F, b: F) -> F:
+    lo, hi = a.lo + b.lo, a.hi + b.hi
+    assert -(2**31) < lo and hi < 2**31, "add overflow (carry an operand)"
+    return F(a.v + b.v, lo, hi)
 
 
-# Column-sum matrix: _COLSUM[k, i*20+j] = 1 iff i+j == k.  Expressing the
-# 20x20 schoolbook column reduction as ONE (39,400)x(400,B) matmul keeps the
-# HLO graph tiny (the unrolled form is ~900 ops per multiply, which made the
-# full verify kernel take minutes to compile) and hands the reduction to the
-# MXU/VPU as a single fused contraction.
-_COLSUM = np.zeros((2 * NLIMBS - 1, NLIMBS * NLIMBS), np.float32)
-for _i in range(NLIMBS):
-    for _j in range(NLIMBS):
-        _COLSUM[_i + _j, _i * NLIMBS + _j] = 1.0
+def sub(a: F, b: F) -> F:
+    lo, hi = a.lo - b.hi, a.hi - b.lo
+    assert -(2**31) < lo and hi < 2**31, "sub overflow (carry an operand)"
+    return F(a.v - b.v, lo, hi)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 20x20 -> 39 columns (one matmul), fold, carry."""
-    a = jnp.broadcast_to(a, jnp.broadcast_shapes(a.shape, b.shape))
-    b = jnp.broadcast_to(b, a.shape)
-    B = a.shape[1]
-    outer = (a[:, None, :] * b[None, :, :]).reshape(NLIMBS * NLIMBS, B)
-    colsum = jnp.asarray(_COLSUM.astype(np.int32))
-    cols_arr = jax.lax.dot_general(
-        colsum,
-        outer,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
-    )  # (39, B); each column < 20 * 2^26 < 2^31
-    # Carry-propagate the 39 columns; the final carry is the (unmasked) value
-    # of virtual column 39 (< 2^14).  Fold columns 20..39 down with
-    # 2^260 ≡ 608.
-    carry, cols = _carry_chain(cols_arr)
-    hi = jnp.concatenate([cols[NLIMBS:], carry[None]], axis=0)  # (20, B)
-    return _carry(cols[:NLIMBS] + FOLD * hi)
+def neg(a: F) -> F:
+    return F(-a.v, -a.hi, -a.lo)
 
 
-def square(a: jnp.ndarray) -> jnp.ndarray:
+def mul_small(a: F, k: int) -> F:
+    """Multiply by a small static nonneg integer (e.g. 2)."""
+    lo, hi = min(a.lo * k, a.hi * k), max(a.lo * k, a.hi * k)
+    assert -(2**31) < lo and hi < 2**31
+    return F(a.v * k, lo, hi)
+
+
+def _reduce_cols(x: jnp.ndarray, colbound: int) -> F:
+    """(40, B) product columns (39 + zero pad, static bound) -> reduced F.
+
+    Stage A: parallel-carry the column array as a plain 40-limb number
+    (no fold) until limbs are small; stage B: fold the high 20 limbs into
+    the low 20 with weight 2^260 ≡ 608; stage C: carry to RED.
+    """
+    lo, hi = -colbound, colbound  # signed limbs -> signed product columns
+    # stage A (fold-free carry: same interval step with FOLD→1)
+    steps = 0
+    while lo < -HALF - 1 or hi > HALF + 1:
+        c_lo, c_hi = (lo + HALF) >> BITS, (hi + HALF) >> BITS
+        lo, hi = -HALF + min(c_lo, 0), HALF - 1 + max(c_hi, 0)
+        steps += 1
+        assert steps <= 6
+    for _ in range(steps):
+        c = (x + HALF) >> BITS
+        r = x - (c << BITS)
+        x = r + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    # stage B: value = lo20 + 2^260 * hi20
+    lo20, hi20 = x[:NLIMBS], x[NLIMBS:]
+    v = lo20 + FOLD * hi20
+    blo, bhi = lo + FOLD * lo, hi + FOLD * hi
+    return carry(F(v, blo, bhi))
+
+
+def mul(a: F, b: F) -> F:
+    """Schoolbook 20x20 product, fully on the VPU (no dot_general).
+
+    The anti-diagonal column sums use a skew-reshape: pad each row i of the
+    (20, 20, B) outer product to width 40, flatten the leading two axes and
+    re-view as (20, 39, B) — element (i, j) lands at (i, j - i), so a single
+    axis-0 sum produces the 39 polynomial columns.  One multiply + one sum:
+    the whole multiplier is ~10 HLO ops, keeping the traced ladder small
+    enough to compile while doing identical VPU work.
+    """
+    # auto-reduce operands until the 20-term column bound fits int32
+    while NLIMBS * a.absmax * b.absmax >= _I32_LIMIT:
+        a, b = (carry(a), b) if a.absmax >= b.absmax else (a, carry(b))
+    n = NLIMBS
+    B = a.v.shape[1]
+    prod = a.v[:, None, :] * b.v[None, :, :]  # (20, 20, B)
+    z = jnp.pad(prod, ((0, 0), (0, n), (0, 0)))  # (20, 40, B)
+    skew = z.reshape(2 * n * n, B)[: n * (2 * n - 1)].reshape(n, 2 * n - 1, B)
+    cols = jnp.sum(skew, axis=0)  # (39, B)
+    x = jnp.concatenate([cols, jnp.zeros((1, B), cols.dtype)], axis=0)
+    return _reduce_cols(x, NLIMBS * a.absmax * b.absmax)
+
+
+def square(a: F) -> F:
     return mul(a, a)
 
 
-def freeze(x: jnp.ndarray) -> jnp.ndarray:
-    """Canonical representative in [0, p): fold bits >= 255, then one
-    conditional subtract of p."""
-    x = _carry(x)
-    topshift = 255 - BITS * (NLIMBS - 1)
-    hi = x[NLIMBS - 1] >> topshift  # bits 255..259 of value
-    x = x.at[NLIMBS - 1].add(-(hi << topshift))
-    x = x.at[0].add(TOP_FOLD * hi)
-    _, rows = _carry_chain(x)
-    # value now < 2^255 + small => at most one subtract of p needed.
-    p = jnp.asarray(_P_LIMBS[:, None], jnp.int32)
-    borrow, y = _carry_chain(rows - p)
-    take_y = borrow == 0  # x >= p
-    return jnp.where(take_y[None, :], y, rows)
+# ---------------------------------------------------------------------------
+# Canonicalization & predicates.
+# ---------------------------------------------------------------------------
+
+def _nonneg_pad(lo: int) -> tuple[np.ndarray, int]:
+    """A limb vector representing K*p whose every limb is >= -lo (so adding
+    it makes any value with limbs >= lo nonneg, without changing the class
+    mod p).  Returns (limbs, max_limb)."""
+    need = max(-lo, 0) + 1
+    base = 1 << max(need - 1, 1).bit_length()  # power of two >= need
+    v0 = base * ((1 << (BITS * NLIMBS)) - 1) // (BASE - 1)
+    k = -(-v0 // P_INT) + 1  # ceil + 1
+    delta = k * P_INT - v0
+    assert delta >= 0
+    dl = np.zeros(NLIMBS, np.int64)
+    for i in range(NLIMBS):
+        dl[i] = delta & MASK
+        delta >>= BITS
+    assert delta == 0, "pad construction overflow"
+    limbs = dl + base
+    assert int_of_limbs(limbs) % P_INT == 0
+    return limbs.astype(np.int64), int(limbs.max())
 
 
-def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+def _ripple(v: jnp.ndarray):
+    """Exact sequential carry pass (20 unrolled slices — no scan, no
+    scatter).  Input limbs must be nonneg; outputs limbs in [0, 2^13) plus
+    the final carry out of limb 19 (weight 2^260)."""
+    rows = []
+    cin = jnp.zeros_like(v[0])
+    for i in range(NLIMBS):
+        t = v[i] + cin
+        cin = t >> BITS
+        rows.append(t & MASK)
+    return jnp.stack(rows), cin
+
+
+def freeze(a: F) -> jnp.ndarray:
+    """Canonical representative in [0, p) as plain (20, B) int32 nonneg
+    limbs.  Used for equality / parity / encoding only."""
+    a = carry(a)
+    pad, pad_max = _nonneg_pad(a.lo)
+    v = a.v + jnp.asarray(pad[:, None].astype(np.int32))
+    hi = a.hi + pad_max
+    assert a.lo + int(pad.min()) >= 0
+    # parallel floor-carries down to the fixpoint (limbs <= MASK + FOLD)
+    steps = 0
+    while hi > MASK + FOLD:
+        hi = MASK + max(hi >> BITS, FOLD * (hi >> BITS))
+        steps += 1
+        assert steps <= 8
+    for _ in range(steps):
+        c = v >> BITS
+        v = (v & MASK) + jnp.concatenate([FOLD * c[-1:], c[:-1]], axis=0)
+    # exact ripple; fold carry-out (2^260 ≡ 608) and top bits 255..259
+    # (2^255 ≡ 19); after two rounds the value is < p + small, then at most
+    # two conditional subtracts of p give the canonical representative.
+    topshift = 255 - BITS * (NLIMBS - 1)  # limb 19 holds bits 247..259
+    p_limbs = jnp.asarray(limbs_of_int(P_INT)[:, None])
+    for _ in range(2):
+        v, cout = _ripple(v)
+        hi_bits = v[NLIMBS - 1] >> topshift
+        v = jnp.concatenate(
+            [
+                v[:1] + (19 * hi_bits + FOLD * cout)[None, :],
+                v[1 : NLIMBS - 1],
+                (v[NLIMBS - 1] - (hi_bits << topshift))[None, :],
+            ],
+            axis=0,
+        )
+    v, _ = _ripple(v)
+    for _ in range(2):
+        # borrow-propagating subtract; keep v - p when nonnegative
+        rows = []
+        cin = jnp.zeros_like(v[0])
+        for i in range(NLIMBS):
+            t = v[i] - p_limbs[i, 0] + cin
+            cin = t >> BITS
+            rows.append(t - (cin << BITS))
+        dv = jnp.stack(rows)
+        geq = cin == 0  # no final borrow => v >= p
+        v = jnp.where(geq[None, :], dv, v)
+    return v
+
+
+def eq(a: F, b: F) -> jnp.ndarray:
     """(B,) bool: a == b mod p."""
     return jnp.all(freeze(sub(a, b)) == 0, axis=0)
 
 
-def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+def is_zero(a: F) -> jnp.ndarray:
     return jnp.all(freeze(a) == 0, axis=0)
 
 
-def parity(a: jnp.ndarray) -> jnp.ndarray:
+def parity(a: F) -> jnp.ndarray:
     """(B,) int32: LSB of the canonical representative."""
     return freeze(a)[0] & 1
 
 
-def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Per-lane select: cond (B,) bool -> limbs from a else b."""
-    return jnp.where(cond[None, :], a, b)
-
-
-def pow_fixed(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
-    """x^exponent for a compile-time-constant exponent, MSB-first
-    square-and-multiply driven by lax.scan (trace stays 2 muls)."""
-    nbits = exponent.bit_length()
-    bits = jnp.asarray(
-        [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], jnp.int32
+def select(cond: jnp.ndarray, a: F, b: F) -> F:
+    """Per-lane select: cond (B,) bool -> a else b."""
+    return F(
+        jnp.where(cond[None, :], a.v, b.v), min(a.lo, b.lo), max(a.hi, b.hi)
     )
-    # `+ (x - x)` ties the initial carry's sharding variance to x so the scan
-    # carry types match under shard_map (constants are unvarying by default).
-    one = jnp.broadcast_to(const(1), x.shape) + (x - x)
 
-    def body(acc, bit):
-        acc = square(acc)
-        acc = jnp.where(bit == 1, mul(acc, x), acc)  # scalar cond broadcasts
-        return acc, None
 
-    acc, _ = lax.scan(body, one, bits)
-    return acc
+# ---------------------------------------------------------------------------
+# Exponentiation: the 2^252-3 chain (decompression square root).
+# ---------------------------------------------------------------------------
+
+def _nsquares(x: F, n: int) -> F:
+    """x^(2^n) via a scanned square (compact HLO for long runs)."""
+    x = carry(x)
+
+    def body(c, _):
+        return carry(square(c)), None
+
+    out, _ = jax.lax.scan(body, x, None, length=n)
+    return out
+
+
+def pow_p58(z: F) -> F:
+    """z^((p-5)/8) = z^(2^252 - 3) with the standard 11-mul addition chain
+    (the reference gets this from curve25519-voi's field inversion chains)."""
+    z2 = square(z)  # 2
+    z4 = square(z2)
+    z8 = square(z4)
+    z9 = mul(z8, z)  # 9
+    z11 = mul(z9, z2)  # 11
+    z22 = square(z11)  # 22
+    z_5_0 = mul(z22, z9)  # 2^5 - 2^0 = 31
+    z_10_5 = _nsquares(z_5_0, 5)
+    z_10_0 = mul(z_10_5, z_5_0)  # 2^10 - 1
+    z_20_10 = _nsquares(z_10_0, 10)
+    z_20_0 = mul(z_20_10, z_10_0)  # 2^20 - 1
+    z_40_20 = _nsquares(z_20_0, 20)
+    z_40_0 = mul(z_40_20, z_20_0)  # 2^40 - 1
+    z_50_40 = _nsquares(z_40_0, 10)
+    z_50_0 = mul(z_50_40, z_10_0)  # 2^50 - 1
+    z_100_50 = _nsquares(z_50_0, 50)
+    z_100_0 = mul(z_100_50, z_50_0)  # 2^100 - 1
+    z_200_100 = _nsquares(z_100_0, 100)
+    z_200_0 = mul(z_200_100, z_100_0)  # 2^200 - 1
+    z_250_200 = _nsquares(z_200_0, 50)
+    z_250_0 = mul(z_250_200, z_50_0)  # 2^250 - 1
+    z_252_2 = _nsquares(z_250_0, 2)  # 2^252 - 4
+    return mul(z_252_2, z)  # 2^252 - 3
 
 
 _SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
 
 
-def sqrt_ratio(u: jnp.ndarray, v: jnp.ndarray):
-    """Return (ok, x) with x = sqrt(u/v) where it exists (the even root is not
-    selected here — callers normalize parity).  ok is (B,) bool."""
+def sqrt_ratio(u: F, v: F):
+    """(ok, x) with x = sqrt(u/v) when it exists (parity not normalized
+    here).  ZIP-215 semantics: ok false iff u/v is a non-square."""
     v3 = mul(square(v), v)
     v7 = mul(square(v3), v)
-    r = pow_fixed(mul(u, v7), (P_INT - 5) // 8)
+    r = pow_p58(mul(u, v7))
     x = mul(mul(u, v3), r)
     vx2 = mul(v, square(x))
     ok1 = eq(vx2, u)
     ok2 = eq(vx2, neg(u))
-    sqrt_m1 = const(_SQRT_M1_INT)
-    x = select(ok2, mul(x, jnp.broadcast_to(sqrt_m1, x.shape)), x)
+    x = select(ok2, mul(x, const(_SQRT_M1_INT)), x)
     return ok1 | ok2, x
+
+
+# ---------------------------------------------------------------------------
+# Device-side byte unpacking (the wire format is bytes; limb packing on
+# device keeps the host->device transfer at 32 B per element).
+# ---------------------------------------------------------------------------
+
+def unpack255(b: jnp.ndarray):
+    """(B, 32) uint8 little-endian -> (F of the low 255 bits, sign bits).
+
+    Returns (y: F with nonneg 13-bit limbs, sign: (B,) int32 from bit 255).
+    Static slicing only — no gather.
+    """
+    x = b.astype(jnp.int32)  # (B, 32)
+    rows = []
+    for i in range(NLIMBS):
+        bit0 = BITS * i
+        k = bit0 >> 3
+        off = bit0 & 7
+        w = x[:, k]
+        if k + 1 < 32:
+            w = w | (x[:, k + 1] << 8)
+        if off + BITS > 16 and k + 2 < 32:
+            w = w | (x[:, k + 2] << 16)
+        limb = (w >> off) & MASK
+        if i == NLIMBS - 1:
+            limb = limb & 0xFF  # bits 247..254 only (strip sign bit 255)
+        rows.append(limb)
+    y = jnp.stack(rows)  # (20, B)
+    sign = (x[:, 31] >> 7) & 1
+    return F(y, 0, MASK), sign
+
+
+def nibbles_msb_first(b: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 little-endian scalar -> (64, B) int32 radix-16 digits,
+    most-significant digit first (processing order of the ladder)."""
+    x = b.astype(jnp.int32)
+    digs = []
+    for k in reversed(range(64)):  # k = nibble index, LSB-first storage
+        byte = x[:, k >> 1]
+        digs.append((byte >> (4 * (k & 1))) & 0xF)
+    return jnp.stack(digs)  # (64, B), row 0 = most significant

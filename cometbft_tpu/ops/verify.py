@@ -1,26 +1,26 @@
 """Batched Ed25519 ZIP-215 verification: host preparation + JAX device kernel.
 
 Pipeline per signature (pub, msg, sig=R||s):
-  host:   h = SHA-512(R || pub || msg) mod L;  m = L - h;  s canonical check;
-          pack y-limbs/sign bits/scalar bits into batch arrays
-  device: ZIP-215 decompress A and R; ladder  s*B + m*A;  subtract R;
-          multiply by cofactor 8; accept iff identity.
+  host:   h = SHA-512(R || pub || msg) mod L;  m = L - h;  s canonical check
+          (the C++ sidecar does this batch-at-a-time; python fallback below)
+  device: unpack bytes -> limbs/digits; ZIP-215 decompress A and R;
+          radix-16 Straus ladder  s*B + m*A;  subtract R;  multiply by
+          cofactor 8; accept iff identity.
 
-Unlike the reference's CPU batch verify (random linear combination + one giant
-multi-scalar-mul, curve25519-voi via crypto/ed25519/ed25519.go:189-222), every
-signature here is verified *independently* in a SIMD lane: on TPU the vmapped
-ladder is the natural shape, and per-signature accept bits come out for free —
-no recheck pass to attribute failures (reference needs one:
-types/validation.go:308-317).
+Unlike the reference's CPU batch verify (random linear combination + one
+giant multi-scalar-mul, curve25519-voi via crypto/ed25519/ed25519.go:189-222),
+every signature here is verified *independently* in a SIMD lane: per-sig
+accept bits come out for free — no recheck pass to attribute failures
+(the reference needs one: types/validation.go:308-317).
 
-The SHA-512 step runs on host by default (hashlib, C speed) and on-device via
-``cometbft_tpu.ops.sha512`` for the fully-fused path.
+The device inputs are RAW BYTES (32 B per element: pub, R, s, m) — limb
+packing and digit extraction happen on device, keeping the host->device
+transfer minimal and the host prep trivial.
 """
 
 from __future__ import annotations
 
 import hashlib
-from functools import partial
 from typing import Sequence
 
 import numpy as np
@@ -31,10 +31,9 @@ from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import ed25519_point as ep
 
 L_INT = 2**252 + 27742317777372353535851937790883648493
-SCALAR_BITS = 253
 
 # Batch buckets: pad to one of these sizes to bound recompilation.
-_BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768]
+_BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 10240, 16384, 32768]
 
 
 def bucket_size(n: int) -> int:
@@ -44,44 +43,39 @@ def bucket_size(n: int) -> int:
     return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
 
 
-def verify_core(ay, asign, ry, rsign, bits_s, bits_m, s_ok):
+def verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
     """Unjitted kernel body — also the per-shard body for the mesh-sharded
-    path (cometbft_tpu.parallel.mesh)."""
-    ok_a, a = ep.decompress(ay, asign)
-    ok_r, r = ep.decompress(ry, rsign)
-    p = ep.double_base_scalar_mul(bits_s, bits_m, a)
+    path (cometbft_tpu.parallel.mesh).
+
+    a_bytes/r_bytes/s_bytes/m_bytes: (B, 32) uint8; s_ok: (B,) bool.
+    Returns (B,) bool accept bits.
+    """
+    ya, sa = fe.unpack255(a_bytes)
+    yr, sr = fe.unpack255(r_bytes)
+    ok_a, a = ep.decompress(ya, sa)
+    ok_r, r = ep.decompress(yr, sr)
+    dig_s = fe.nibbles_msb_first(s_bytes)
+    dig_m = fe.nibbles_msb_first(m_bytes)
+    p = ep.double_base_scalar_mul(dig_s, dig_m, a)
     q = ep.add(p, ep.negate(r))
-    # Cofactored equation: [8](s*B - h*A - R) == identity (ZIP-215).
-    q = ep.double(ep.double(ep.double(q)))
+    # Cofactored equation: [8](s*B + m*A - R) == identity (ZIP-215).
+    q = ep.double(ep.double(ep.double(q, need_t=False), need_t=False))
     return ok_a & ok_r & s_ok & ep.is_identity(q)
 
 
 _verify_kernel = jax.jit(verify_core)
 
 
-def _scalars_to_bits(scalars: np.ndarray) -> np.ndarray:
-    """(B, 32) uint8 little-endian scalars -> (253, B) int32, MSB first."""
-    bits = np.unpackbits(scalars, axis=1, bitorder="little")[:, :SCALAR_BITS]
-    return bits[:, ::-1].T.astype(np.int32)  # MSB-first, bit-major
-
-
-def _int_to_bytes32(vals) -> np.ndarray:
-    out = np.zeros((len(vals), 32), np.uint8)
-    for i, v in enumerate(vals):
-        out[i] = np.frombuffer(v.to_bytes(32, "little"), np.uint8)
-    return out
-
-
 def prepare_batch(
     pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ):
-    """Host-side packing.  Returns (arrays, n, structural_ok) where arrays are
-    the padded device inputs and structural_ok marks length-valid entries.
+    """Host-side packing.  Returns (arrays, n, structural_ok): ``arrays``
+    holds the padded uint8 device inputs and structural_ok marks
+    length-valid entries.
 
     The per-signature SHA-512 + mod-L math runs in the C++ sidecar when
-    available (cometbft_tpu/native — the host half of the verify pipeline,
-    SURVEY.md §7 step 2); the Python loop below is the fallback and the
-    differential oracle for it.
+    available (cometbft_tpu/native — the host half of the verify pipeline);
+    the Python loop below is the fallback and the differential oracle for it.
     """
     n = len(pubs)
     b = bucket_size(max(n, 1))
@@ -149,20 +143,11 @@ def prepare_batch(
                 s_bytes[i] = np.frombuffer(s_enc, np.uint8)
             m_bytes[i] = np.frombuffer(m.to_bytes(32, "little"), np.uint8)
 
-    a_sign = (pub_arr[:, 31] >> 7).astype(np.int32)
-    r_sign = (r_arr[:, 31] >> 7).astype(np.int32)
-    pub_masked = pub_arr.copy()
-    pub_masked[:, 31] &= 0x7F
-    r_masked = r_arr.copy()
-    r_masked[:, 31] &= 0x7F
-
     arrays = dict(
-        ay=fe.bytes_to_limbs(pub_masked),
-        asign=a_sign,
-        ry=fe.bytes_to_limbs(r_masked),
-        rsign=r_sign,
-        bits_s=_scalars_to_bits(s_bytes),
-        bits_m=_scalars_to_bits(m_bytes),
+        a_bytes=pub_arr,
+        r_bytes=r_arr,
+        s_bytes=s_bytes,
+        m_bytes=m_bytes,
         s_ok=s_ok,
     )
     return arrays, n, structural
@@ -173,5 +158,7 @@ def verify_batch(
 ) -> np.ndarray:
     """Verify a batch; returns (n,) bool numpy array of per-signature results."""
     arrays, n, structural = prepare_batch(pubs, msgs, sigs)
-    accept = np.asarray(_verify_kernel(**{k: jnp.asarray(v) for k, v in arrays.items()}))
+    accept = np.asarray(
+        _verify_kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
+    )
     return (accept & structural)[:n]
