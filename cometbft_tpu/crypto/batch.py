@@ -20,6 +20,7 @@ backends report per-signature validity directly.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Optional
@@ -30,9 +31,43 @@ _DEFAULT_BACKEND: Optional[str] = None
 _LOCK = threading.Lock()
 
 
+def _tpu_self_check() -> bool:
+    """Startup safety net: verify a known-good + known-bad signature pair on
+    the accelerator before trusting it for consensus.  A kernel regression
+    (round 2 shipped one) otherwise makes the node reject every valid commit
+    on TPU hardware.  Returns True iff the backend is trustworthy."""
+    try:
+        from cometbft_tpu.crypto import ed25519_ref as ref
+        from cometbft_tpu.ops import verify as _ops_verify
+
+        seed = b"\x42" * 32
+        pub = ref.pubkey_from_seed(seed)
+        msg = b"cometbft-tpu backend self-check"
+        sig = ref.sign(seed, msg)
+        bad = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        bits = _ops_verify.verify_batch([pub, pub], [msg, msg], [sig, bad])
+        ok = bool(bits[0]) and not bool(bits[1])
+        if not ok:
+            logging.getLogger("cometbft_tpu.crypto").error(
+                "TPU crypto backend FAILED its known-answer self-check "
+                "(valid=%s, tampered=%s) — falling back to the CPU verify "
+                "path; consensus is safe but orders of magnitude slower",
+                bool(bits[0]),
+                bool(bits[1]),
+            )
+        return ok
+    except Exception:
+        logging.getLogger("cometbft_tpu.crypto").exception(
+            "TPU crypto backend self-check raised — falling back to the "
+            "CPU verify path"
+        )
+        return False
+
+
 def default_backend() -> str:
-    """'tpu' when an accelerator is visible to JAX, else 'cpu'.  Overridable
-    via config (config.crypto.backend) or COMETBFT_TPU_CRYPTO_BACKEND."""
+    """'tpu' when an accelerator is visible to JAX *and* it passes a
+    known-answer self-check, else 'cpu'.  Overridable via config
+    (config.crypto.backend) or COMETBFT_TPU_CRYPTO_BACKEND."""
     global _DEFAULT_BACKEND
     env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
     if env and env != "auto":
@@ -43,7 +78,10 @@ def default_backend() -> str:
                 import jax
 
                 platform = jax.devices()[0].platform
-                _DEFAULT_BACKEND = "cpu" if platform == "cpu" else "tpu"
+                if platform == "cpu":
+                    _DEFAULT_BACKEND = "cpu"
+                else:
+                    _DEFAULT_BACKEND = "tpu" if _tpu_self_check() else "cpu"
             except Exception:
                 _DEFAULT_BACKEND = "cpu"
         return _DEFAULT_BACKEND

@@ -175,10 +175,15 @@ def select_base(digit: jnp.ndarray):
     (constant table is the shared operand -> MXU, not VPU)."""
     onehot = (digit[None, :] == jnp.arange(WINDOW, dtype=jnp.int32)[:, None])
     tbl = jnp.asarray(_niels_base_table())
+    # HIGHEST precision is required: the TPU MXU's default f32 path truncates
+    # operands to bf16 (8-bit mantissa), which corrupts 13-bit table limbs at
+    # real batch sizes (round-3 finding; CPU was exact either way).  HIGHEST
+    # selects the multi-pass f32 algorithm — exact for values < 2^24.
     sel = lax.dot_general(
         tbl,
         onehot.astype(jnp.float32),
         (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
     )  # (60, B) exact: one nonzero per column, values < 2^13 < 2^24
     sel = sel.astype(jnp.int32)
     n = fe.NLIMBS

@@ -178,23 +178,37 @@ def _reduce_cols(x: jnp.ndarray, colbound: int) -> F:
     Stage A: parallel-carry the column array as a plain 40-limb number
     (no fold) until limbs are small; stage B: fold the high 20 limbs into
     the low 20 with weight 2^260 ≡ 608; stage C: carry to RED.
+
+    Limb 39 (the zero pad) receives carries from limb 38 but never emits
+    one — a carry out of limb 39 has weight 2^520 and there is nowhere
+    sound to put it, so instead limb 39 accumulates un-carried with its
+    own (wider) static interval, and stage B folds it like the rest.
+    (Round-2 bug: the carry out of limb 39 was silently dropped, losing
+    c39*2^520 whenever |cols[38]| >= 2^25 — data-dependent corruption.)
     """
     lo, hi = -colbound, colbound  # signed limbs -> signed product columns
+    top_lo, top_hi = 0, 0  # limb 39 starts at the zero pad, accumulates
     # stage A (fold-free carry: same interval step with FOLD→1)
     steps = 0
     while lo < -HALF - 1 or hi > HALF + 1:
         c_lo, c_hi = (lo + HALF) >> BITS, (hi + HALF) >> BITS
+        top_lo += min(c_lo, 0)
+        top_hi += max(c_hi, 0)
         lo, hi = -HALF + min(c_lo, 0), HALF - 1 + max(c_hi, 0)
         steps += 1
         assert steps <= 6
     for _ in range(steps):
         c = (x + HALF) >> BITS
+        # zero limb 39's carry: it must accumulate, not emit (see above)
+        c = jnp.concatenate([c[:-1], jnp.zeros_like(c[-1:])], axis=0)
         r = x - (c << BITS)
         x = r + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
     # stage B: value = lo20 + 2^260 * hi20
     lo20, hi20 = x[:NLIMBS], x[NLIMBS:]
     v = lo20 + FOLD * hi20
-    blo, bhi = lo + FOLD * lo, hi + FOLD * hi
+    blo = lo + FOLD * min(lo, top_lo)
+    bhi = hi + FOLD * max(hi, top_hi)
+    assert -(2**31) < blo and bhi < 2**31, "stage-B fold overflow"
     return carry(F(v, blo, bhi))
 
 

@@ -30,7 +30,9 @@ from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import verify as ov
 
 SIG_AXIS = "sig"
-ARG_ORDER = ("ay", "asign", "ry", "rsign", "bits_s", "bits_m", "s_ok")
+# Packed batch arrays from ops.verify.prepare_batch: raw bytes, batch-major
+# (B, 32) — limb unpacking happens per-shard on device.
+ARG_ORDER = ("a_bytes", "r_bytes", "s_bytes", "m_bytes", "s_ok")
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -39,10 +41,10 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), (SIG_AXIS,))
 
 
-def _verify_shard(ay, asign, ry, rsign, bits_s, bits_m, s_ok):
+def _verify_shard(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
     """Per-device body: verify the local shard, contribute to the global
     accept count via one psum (the only collective)."""
-    accept = ov.verify_core(ay, asign, ry, rsign, bits_s, bits_m, s_ok)
+    accept = ov.verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok)
     n_ok = jax.lax.psum(jnp.sum(accept.astype(jnp.int32)), SIG_AXIS)
     return accept, n_ok
 
@@ -58,23 +60,21 @@ def sharded_verify_fn(mesh: Mesh):
     key = tuple((d.platform, d.id) for d in mesh.devices.flat)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
-    batch_last = NamedSharding(mesh, P(None, SIG_AXIS))
+    batch_first = NamedSharding(mesh, P(SIG_AXIS, None))
     vec = NamedSharding(mesh, P(SIG_AXIS))
     fn = shard_map(
         _verify_shard,
         mesh=mesh,
         in_specs=(
-            P(None, SIG_AXIS),  # ay
-            P(SIG_AXIS),        # asign
-            P(None, SIG_AXIS),  # ry
-            P(SIG_AXIS),        # rsign
-            P(None, SIG_AXIS),  # bits_s
-            P(None, SIG_AXIS),  # bits_m
-            P(SIG_AXIS),        # s_ok
+            P(SIG_AXIS, None),  # a_bytes (B, 32)
+            P(SIG_AXIS, None),  # r_bytes (B, 32)
+            P(SIG_AXIS, None),  # s_bytes (B, 32)
+            P(SIG_AXIS, None),  # m_bytes (B, 32)
+            P(SIG_AXIS),        # s_ok (B,)
         ),
         out_specs=(P(SIG_AXIS), P()),
     )
-    out = (jax.jit(fn), (batch_last, vec))
+    out = (jax.jit(fn), (batch_first, vec))
     _FN_CACHE[key] = out
     return out
 
@@ -87,20 +87,21 @@ def device_put_args(arrays: dict, mesh: Mesh) -> list:
     even be part of the mesh — MULTICHIP_r01 failed exactly this way).
     """
     fn_shardings = sharded_verify_fn(mesh)[1]
-    batch_last, vec = fn_shardings
+    batch_first, vec = fn_shardings
     return [
         jax.device_put(
             np.asarray(arrays[k]),
-            batch_last if np.asarray(arrays[k]).ndim == 2 else vec,
+            batch_first if np.asarray(arrays[k]).ndim == 2 else vec,
         )
         for k in ARG_ORDER
     ]
 
 
 def pad_to_mesh(arrays: dict, mesh: Mesh) -> dict:
-    """Pad the batch axis up to a multiple of the mesh size."""
+    """Pad the batch axis (axis 0, batch-major layout) up to a multiple of
+    the mesh size."""
     n_dev = mesh.devices.size
-    b = arrays["asign"].shape[0]
+    b = arrays["s_ok"].shape[0]
     pad = (-b) % n_dev
     if pad == 0:
         return arrays
@@ -109,7 +110,9 @@ def pad_to_mesh(arrays: dict, mesh: Mesh) -> dict:
         if v.ndim == 1:
             out[k] = np.concatenate([v, np.zeros((pad,), v.dtype)])
         else:
-            out[k] = np.concatenate([v, np.zeros((v.shape[0], pad), v.dtype)], axis=1)
+            out[k] = np.concatenate(
+                [v, np.zeros((pad, v.shape[1]), v.dtype)], axis=0
+            )
     return out
 
 

@@ -24,7 +24,10 @@ def _pts_to_batch(pts):
         cols["z"].append(fe.limbs_of_int(Z))
         cols["t"].append(fe.limbs_of_int(T))
     return ep.PointBatch(
-        *(jnp.asarray(np.stack(cols[k], axis=1)) for k in "xyzt")
+        *(
+            fe.F(jnp.asarray(np.stack(cols[k], axis=1)), 0, fe.MASK)
+            for k in "xyzt"
+        )
     )
 
 
@@ -84,10 +87,8 @@ def test_decompress_matches_oracle():
     encs.append((2).to_bytes(32, "little"))  # non-point (non-square)
     encs.append(bytes(32))  # y = 0
     arr = np.stack([np.frombuffer(e, np.uint8) for e in encs])
-    sign = (arr[:, 31] >> 7).astype(np.int32)
-    masked = arr.copy()
-    masked[:, 31] &= 0x7F
-    ok, pb = ep.decompress(jnp.asarray(fe.bytes_to_limbs(masked)), jnp.asarray(sign))
+    y, sign = fe.unpack255(jnp.asarray(arr))
+    ok, pb = ep.decompress(y, sign)
     ok = np.asarray(ok)
     affs = _batch_to_affine(pb)
     for i, e in enumerate(encs):
@@ -95,6 +96,34 @@ def test_decompress_matches_oracle():
         assert bool(ok[i]) == (expect is not None), f"enc {i}"
         if expect is not None:
             assert affs[i] == _affine(expect), f"enc {i}"
+
+
+def test_double_base_scalar_mul_matches_oracle():
+    """s*B + m*A vs the oracle — includes s=48 (the round-2 regression:
+    a dropped stage-A carry in _reduce_cols corrupted data-dependently)."""
+    svals = [48, 49, 255, 4096, 3, 16, 32, ref.L - 1, 2**251 + 12345]
+    mvals = [0, 0, 0, 7, ref.L - 2, 48, 2**250 - 1, 1, 98765]
+    ka = [1, 2, 3, 5, 8, 11, 99, 1234, ref.L - 3]
+    apts = [ref.pt_mul(k, ref.BASE) for k in ka]
+    pb = _pts_to_batch(apts)
+
+    def enc(vals):
+        arr = np.stack(
+            [
+                np.frombuffer(int(v).to_bytes(32, "little"), np.uint8)
+                for v in vals
+            ]
+        )
+        return fe.nibbles_msb_first(jnp.asarray(arr))
+
+    got = _batch_to_affine(
+        ep.double_base_scalar_mul(enc(svals), enc(mvals), pb)
+    )
+    expect = [
+        _affine(ref.pt_add(ref.pt_mul(s, ref.BASE), ref.pt_mul(m, a)))
+        for s, m, a in zip(svals, mvals, apts)
+    ]
+    assert got == expect
 
 
 def _sign_batch(n, tamper=None):
