@@ -25,17 +25,24 @@ BASELINE_VERIFIES_PER_SEC = 500_000.0
 
 
 def _make_batch(n: int):
+    """n (pub, msg, sig) triples: up to 2048 distinct python-oracle
+    signatures, tiled to n.  The device work is data-independent per lane
+    (branch-free ladder), so tiling does not flatter the throughput
+    number; it just keeps host-side signing (pure python big-int, ~4 ms
+    per signature) out of the benchmark's setup time."""
     from cometbft_tpu.crypto import ed25519_ref as ref
 
+    distinct = min(n, 2048)
     pubs, msgs, sigs = [], [], []
-    for i in range(n):
+    for i in range(distinct):
         seed = i.to_bytes(4, "little") * 8
         pub = ref.pubkey_from_seed(seed)
         msg = b"bench-%d" % i
         pubs.append(pub)
         msgs.append(msg)
         sigs.append(ref.sign(seed, msg))
-    return pubs, msgs, sigs
+    reps = -(-n // distinct)
+    return (pubs * reps)[:n], (msgs * reps)[:n], (sigs * reps)[:n]
 
 
 def main() -> None:
@@ -45,35 +52,38 @@ def main() -> None:
 
     from cometbft_tpu.ops import verify as ov
 
-    n = int(os.environ.get("BENCH_BATCH", "8192"))
+    n = int(os.environ.get("BENCH_BATCH", "32768"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
 
-    pubs, msgs, sigs = _make_batch(n)
-    arrays, _, structural = ov.prepare_batch(pubs, msgs, sigs)
-    dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+    kernel = (
+        ov._verify_kernel_pallas if ov._use_pallas() else ov._verify_kernel
+    )
 
-    # Warm-up / compile.
-    accept = np.asarray(ov._verify_kernel(**dev))
-    assert accept[:n].all(), "benchmark batch failed to verify"
+    def measure(batch):
+        pubs, msgs, sigs = _make_batch(batch)
+        arrays, _, _ = ov.prepare_batch(pubs, msgs, sigs)
+        dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+        accept = np.asarray(kernel(**dev))
+        assert accept[:batch].all(), "benchmark batch failed to verify"
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(kernel(**dev))
+            times.append(time.perf_counter() - t0)
+        return min(times), (pubs, msgs, sigs)
 
-    # Device-kernel throughput (arrays resident).
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        ov._verify_kernel(**dev)[0].block_until_ready()
-        times.append(time.perf_counter() - t0)
-    kernel_s = min(times)
+    # Device-kernel throughput (arrays resident) at the headline batch.
+    kernel_s, (pubs, msgs, sigs) = measure(n)
     vps = n / kernel_s
+
+    # 10k-validator commit shape, measured directly (10240 bucket).
+    commit10k_s, _ = measure(10_240)
 
     # End-to-end (host prep incl. SHA-512 + packing + transfer + kernel).
     t0 = time.perf_counter()
     bits = ov.verify_batch(pubs, msgs, sigs)
     e2e_s = time.perf_counter() - t0
     assert bits.all()
-
-    # 10k-validator commit shape: kernel time at n=10240 bucket if batch
-    # matches, else scale estimate from measured kernel rate.
-    commit10k_ms = 10_000 / vps * 1e3
 
     result = {
         "metric": "ed25519_batch_verify_throughput",
@@ -83,7 +93,8 @@ def main() -> None:
         "batch": n,
         "kernel_s": round(kernel_s, 6),
         "e2e_s": round(e2e_s, 6),
-        "commit10k_est_ms": round(commit10k_ms, 3),
+        "commit10k_ms": round(commit10k_s * 1e3, 3),
+        "impl": "pallas" if ov._use_pallas() else "xla",
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))

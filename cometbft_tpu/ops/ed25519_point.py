@@ -54,10 +54,7 @@ class TablePoint(NamedTuple):
     t2d: fe.F
 
 
-def _red(f: fe.F) -> fe.F:
-    """Carry + widen bounds to exactly the RED hull (stable scan carries)."""
-    f = fe.carry(f)
-    return fe.F(f.v, fe.RED_LO, fe.RED_HI)
+_red = fe.red  # carry + widen bounds to exactly the RED hull (loop-stable)
 
 
 def identity(batch: int) -> PointBatch:
@@ -170,11 +167,17 @@ def _niels_base_table() -> np.ndarray:
     return out.reshape(3 * fe.NLIMBS, WINDOW)
 
 
-def select_base(digit: jnp.ndarray):
+def select_base(digit: jnp.ndarray, tbl: jnp.ndarray | None = None):
     """digit (B,) in [0,16) -> niels triple of digit*B via exact f32 matmul
-    (constant table is the shared operand -> MXU, not VPU)."""
-    onehot = (digit[None, :] == jnp.arange(WINDOW, dtype=jnp.int32)[:, None])
-    tbl = jnp.asarray(_niels_base_table())
+    (constant table is the shared operand -> MXU, not VPU).
+
+    ``tbl`` lets a Pallas caller pass the table as a kernel input (Pallas
+    rejects closure-captured array constants); defaults to the baked one."""
+    onehot = digit[None, :] == lax.broadcasted_iota(
+        jnp.int32, (WINDOW, digit.shape[0]), 0
+    )
+    if tbl is None:
+        tbl = jnp.asarray(_niels_base_table())
     # HIGHEST precision is required: the TPU MXU's default f32 path truncates
     # operands to bf16 (8-bit mantissa), which corrupts 13-bit table limbs at
     # real batch sizes (round-3 finding; CPU was exact either way).  HIGHEST
@@ -216,7 +219,8 @@ def select_table_a(table, digit: jnp.ndarray) -> TablePoint:
     (the table differs per lane, so there is no shared operand for the
     MXU).  Values stay int32 exact."""
     onehot = (
-        digit[None, :] == jnp.arange(WINDOW, dtype=jnp.int32)[:, None]
+        digit[None, :]
+        == lax.broadcasted_iota(jnp.int32, (WINDOW, digit.shape[0]), 0)
     ).astype(jnp.int32)  # (16, B)
     outs = []
     for c in table:  # (16, 20, B)
@@ -232,35 +236,56 @@ def select_table_a(table, digit: jnp.ndarray) -> TablePoint:
 # ---------------------------------------------------------------------------
 
 def double_base_scalar_mul(
-    dig_s: jnp.ndarray, dig_m: jnp.ndarray, a: PointBatch
+    dig_s: jnp.ndarray | None,
+    dig_m: jnp.ndarray | None,
+    a: PointBatch,
+    niels_tbl: jnp.ndarray | None = None,
+    dig_get=None,
+    batch: int | None = None,
 ) -> PointBatch:
     """Compute s*B + m*A jointly (radix-16 Straus).
 
     dig_s, dig_m: (64, B) int32 digits in [0,16), most significant first.
     Per position: 4 doublings, one complete add of {0..15}*A (per-lane
-    table), one niels add of {0..15}*B (constant table).
+    table), one niels add of {0..15}*B (constant table; pass ``niels_tbl``
+    explicitly from inside a Pallas kernel).
+
+    ``dig_get``: optional ``i -> (ds, dm)`` provider overriding the array
+    arguments — a Pallas kernel passes a closure reading its digit *refs*
+    (Mosaic lowers dynamic ref loads but not value dynamic_slice).
     """
-    batch = dig_s.shape[1]
+    if dig_get is None:
+        batch = dig_s.shape[1]
+
+        def dig_get(i):
+            return (
+                lax.dynamic_index_in_dim(dig_s, i, axis=0, keepdims=False),
+                lax.dynamic_index_in_dim(dig_m, i, axis=0, keepdims=False),
+            )
+
+    elif batch is None:
+        batch = a.x.v.shape[1]
+
     table_a = build_table_a(a)
 
     def norm(p: PointBatch) -> PointBatch:
         return PointBatch(*(_red(c) for c in p))
 
-    def body(p, digs):
-        ds, dm = digs
+    def body(i, p):
+        ds, dm = dig_get(i)
         p = double(p, need_t=False)
         p = double(p, need_t=False)
         p = double(p, need_t=False)
         p = double(p, need_t=True)
         p = add_table(p, select_table_a(table_a, dm))
-        ypx, ymx, t2d = select_base(ds)
+        ypx, ymx, t2d = select_base(ds, niels_tbl)
         p = madd_niels(p, ypx, ymx, t2d)
-        return norm(p), None
+        return norm(p)
 
     p0 = norm(identity(batch))
     # tie sharding variance of the initial carry to the (varying) input so
-    # scan carry types match under shard_map
+    # loop carry types match under shard_map
     zero = a.x.v - a.x.v
     p0 = PointBatch(*(fe.F(c.v + zero, c.lo, c.hi) for c in p0))
-    p, _ = lax.scan(body, p0, (dig_s, dig_m))
-    return p
+    # fori_loop, not scan: the same ladder lowers under Mosaic/Pallas
+    return lax.fori_loop(0, NPOS, body, p0)

@@ -90,13 +90,51 @@ def int_of_limbs(x) -> int:
     return n
 
 
+def _rows_const(limbs, batch: int, dtype=jnp.int32) -> jnp.ndarray:
+    """(len(limbs), batch) constant built from scalar literals only —
+    Pallas kernels reject closure-captured array constants, and scalar
+    ``jnp.full`` rows lower fine both there and under plain XLA (which
+    constant-folds the concatenate)."""
+    return jnp.concatenate(
+        [jnp.full((1, batch), int(l), dtype) for l in limbs], axis=0
+    )
+
+
+# Kernel (Pallas) tracing mode.  Outside Pallas: constants default to
+# width 1 (broadcast against (20, B) operands is free under XLA) and mul
+# uses the compact skew-reshape (few eager dispatches).  Inside a Pallas
+# kernel: constants are built at full tile width (Mosaic mis-lowers some
+# width-1 broadcasts) and mul uses the reshape-free shifted-row form
+# (Mosaic has no sublane reshape).
+_CONST_BATCH: list[int] = [1]
+_KERNEL_MODE: list[bool] = [False]
+
+
+class kernel_mode:
+    """Context manager marking Pallas-kernel tracing: sets the default
+    constant width to the kernel tile and switches mul to the
+    Mosaic-compatible formulation."""
+
+    def __init__(self, batch: int):
+        self.batch = batch
+
+    def __enter__(self):
+        _CONST_BATCH.append(self.batch)
+        _KERNEL_MODE.append(True)
+
+    def __exit__(self, *exc):
+        _CONST_BATCH.pop()
+        _KERNEL_MODE.pop()
+
+
 def const(n: int, batch: int | None = None) -> F:
     """A field constant, broadcastable over the batch."""
     limbs = limbs_of_int(n % P_INT)
-    arr = jnp.asarray(limbs[:, None])
-    if batch is not None:
-        arr = jnp.broadcast_to(arr, (NLIMBS, batch))
-    return F(arr, 0, MASK)
+    return F(
+        _rows_const(limbs, batch if batch is not None else _CONST_BATCH[-1]),
+        0,
+        MASK,
+    )
 
 
 def zero_like(a: F) -> F:
@@ -143,6 +181,14 @@ def carry(a: F) -> F:
         v = _carry_once(v)
         lo, hi = _carry_interval_step(lo, hi)
     return F(v, max(lo, RED_LO), min(hi, RED_HI))
+
+
+def red(a: F) -> F:
+    """Carry, then *widen* the static bounds to the exact RED hull.  Loop
+    carries (fori_loop/scan) need a fixed-point bound signature — red(x)
+    always has bounds (RED_LO, RED_HI) so iterated bodies type-match."""
+    c = carry(a)
+    return F(c.v, RED_LO, RED_HI)
 
 
 # ---------------------------------------------------------------------------
@@ -212,27 +258,48 @@ def _reduce_cols(x: jnp.ndarray, colbound: int) -> F:
     return carry(F(v, blo, bhi))
 
 
-def mul(a: F, b: F) -> F:
-    """Schoolbook 20x20 product, fully on the VPU (no dot_general).
-
-    The anti-diagonal column sums use a skew-reshape: pad each row i of the
-    (20, 20, B) outer product to width 40, flatten the leading two axes and
-    re-view as (20, 39, B) — element (i, j) lands at (i, j - i), so a single
-    axis-0 sum produces the 39 polynomial columns.  One multiply + one sum:
-    the whole multiplier is ~10 HLO ops, keeping the traced ladder small
-    enough to compile while doing identical VPU work.
-    """
-    # auto-reduce operands until the 20-term column bound fits int32
-    while NLIMBS * a.absmax * b.absmax >= _I32_LIMIT:
-        a, b = (carry(a), b) if a.absmax >= b.absmax else (a, carry(b))
+def _cols_skew(a: F, b: F) -> jnp.ndarray:
+    """(40, B) product columns via skew-reshape: pad each row i of the
+    (20, 20, B) outer product to width 40, flatten the leading two axes
+    and re-view as (20, 39, B) — element (i, j) lands at (i, j - i), so a
+    single axis-0 sum produces the 39 polynomial columns.  ~10 HLO ops:
+    the fast form for eager execution and plain XLA."""
     n = NLIMBS
     B = a.v.shape[1]
     prod = a.v[:, None, :] * b.v[None, :, :]  # (20, 20, B)
     z = jnp.pad(prod, ((0, 0), (0, n), (0, 0)))  # (20, 40, B)
     skew = z.reshape(2 * n * n, B)[: n * (2 * n - 1)].reshape(n, 2 * n - 1, B)
     cols = jnp.sum(skew, axis=0)  # (39, B)
-    x = jnp.concatenate([cols, jnp.zeros((1, B), cols.dtype)], axis=0)
-    return _reduce_cols(x, NLIMBS * a.absmax * b.absmax)
+    return jnp.concatenate([cols, jnp.zeros((1, B), cols.dtype)], axis=0)
+
+
+def _cols_rows(a: F, b: F) -> jnp.ndarray:
+    """(40, B) product columns via shifted-row accumulation: 20 full-array
+    FMAs, ``acc[j:j+20] += a * b[j]`` as sublane-padded adds.  No 3-D
+    intermediates and no reshapes — the only form Mosaic (Pallas) lowers;
+    compiled XLA speed is on par with the skew form, eager speed is not
+    (~8x the dispatches), hence the mode switch."""
+    n = NLIMBS
+    B = a.v.shape[1]
+    acc = None
+    for j in range(n):
+        prod = a.v * b.v[j][None, :]  # (20, B)
+        # rows j..j+19 hold the shifted partial product; skip the j=0
+        # zero-height leading pad — Mosaic rejects 0-sized vectors
+        parts = [prod] if j == 0 else [jnp.zeros((j, B), a.v.dtype), prod]
+        parts.append(jnp.zeros((n - j, B), a.v.dtype))
+        padded = jnp.concatenate(parts, axis=0)
+        acc = padded if acc is None else acc + padded
+    return acc
+
+
+def mul(a: F, b: F) -> F:
+    """Schoolbook 20x20 product, fully on the VPU (no dot_general)."""
+    # auto-reduce operands until the 20-term column bound fits int32
+    while NLIMBS * a.absmax * b.absmax >= _I32_LIMIT:
+        a, b = (carry(a), b) if a.absmax >= b.absmax else (a, carry(b))
+    cols = (_cols_rows if _KERNEL_MODE[-1] else _cols_skew)(a, b)
+    return _reduce_cols(cols, NLIMBS * a.absmax * b.absmax)
 
 
 def square(a: F) -> F:
@@ -266,14 +333,17 @@ def _nonneg_pad(lo: int) -> tuple[np.ndarray, int]:
 def _ripple(v: jnp.ndarray):
     """Exact sequential carry pass (20 unrolled slices — no scan, no
     scatter).  Input limbs must be nonneg; outputs limbs in [0, 2^13) plus
-    the final carry out of limb 19 (weight 2^260)."""
+    the final carry out of limb 19 (weight 2^260, shape (1, B)).
+
+    All intermediates stay 2-D ((1, B) row slices, concatenated at the
+    end) so the same code lowers inside a Pallas kernel."""
     rows = []
-    cin = jnp.zeros_like(v[0])
+    cin = jnp.zeros_like(v[:1])
     for i in range(NLIMBS):
-        t = v[i] + cin
+        t = v[i : i + 1] + cin
         cin = t >> BITS
         rows.append(t & MASK)
-    return jnp.stack(rows), cin
+    return jnp.concatenate(rows, axis=0), cin
 
 
 def freeze(a: F) -> jnp.ndarray:
@@ -281,7 +351,7 @@ def freeze(a: F) -> jnp.ndarray:
     limbs.  Used for equality / parity / encoding only."""
     a = carry(a)
     pad, pad_max = _nonneg_pad(a.lo)
-    v = a.v + jnp.asarray(pad[:, None].astype(np.int32))
+    v = a.v + _rows_const(pad, a.v.shape[1])
     hi = a.hi + pad_max
     assert a.lo + int(pad.min()) >= 0
     # parallel floor-carries down to the fixpoint (limbs <= MASK + FOLD)
@@ -297,15 +367,15 @@ def freeze(a: F) -> jnp.ndarray:
     # (2^255 ≡ 19); after two rounds the value is < p + small, then at most
     # two conditional subtracts of p give the canonical representative.
     topshift = 255 - BITS * (NLIMBS - 1)  # limb 19 holds bits 247..259
-    p_limbs = jnp.asarray(limbs_of_int(P_INT)[:, None])
+    p_limbs = limbs_of_int(P_INT)
     for _ in range(2):
         v, cout = _ripple(v)
-        hi_bits = v[NLIMBS - 1] >> topshift
+        hi_bits = v[NLIMBS - 1 :] >> topshift  # (1, B)
         v = jnp.concatenate(
             [
-                v[:1] + (19 * hi_bits + FOLD * cout)[None, :],
+                v[:1] + 19 * hi_bits + FOLD * cout,
                 v[1 : NLIMBS - 1],
-                (v[NLIMBS - 1] - (hi_bits << topshift))[None, :],
+                v[NLIMBS - 1 :] - (hi_bits << topshift),
             ],
             axis=0,
         )
@@ -313,14 +383,14 @@ def freeze(a: F) -> jnp.ndarray:
     for _ in range(2):
         # borrow-propagating subtract; keep v - p when nonnegative
         rows = []
-        cin = jnp.zeros_like(v[0])
+        cin = jnp.zeros_like(v[:1])
         for i in range(NLIMBS):
-            t = v[i] - p_limbs[i, 0] + cin
+            t = v[i : i + 1] - int(p_limbs[i]) + cin
             cin = t >> BITS
             rows.append(t - (cin << BITS))
-        dv = jnp.stack(rows)
-        geq = cin == 0  # no final borrow => v >= p
-        v = jnp.where(geq[None, :], dv, v)
+        dv = jnp.concatenate(rows, axis=0)
+        geq = cin == 0  # (1, B): no final borrow => v >= p
+        v = jnp.where(geq, dv, v)
     return v
 
 
@@ -350,14 +420,13 @@ def select(cond: jnp.ndarray, a: F, b: F) -> F:
 # ---------------------------------------------------------------------------
 
 def _nsquares(x: F, n: int) -> F:
-    """x^(2^n) via a scanned square (compact HLO for long runs)."""
-    x = carry(x)
+    """x^(2^n) via a fori_loop of squares (compact HLO for long runs;
+    fori_loop — not scan — so the same code lowers under Mosaic/Pallas)."""
 
-    def body(c, _):
-        return carry(square(c)), None
+    def body(_, c):
+        return red(square(c))
 
-    out, _ = jax.lax.scan(body, x, None, length=n)
-    return out
+    return jax.lax.fori_loop(0, n, body, red(x))
 
 
 def pow_p58(z: F) -> F:

@@ -66,6 +66,31 @@ def verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
 _verify_kernel = jax.jit(verify_core)
 
 
+def _use_pallas() -> bool:
+    """Pallas kernel on real TPU hardware; plain-XLA everywhere else (CPU
+    tests, virtual meshes).  COMETBFT_TPU_VERIFY_IMPL=pallas|xla overrides."""
+    import os
+
+    env = os.environ.get("COMETBFT_TPU_VERIFY_IMPL")
+    if env == "pallas":
+        return True
+    if env == "xla":
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@jax.jit
+def _verify_kernel_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
+    from cometbft_tpu.ops import pallas_verify
+
+    return pallas_verify.verify_core_pallas(
+        a_bytes, r_bytes, s_bytes, m_bytes, s_ok
+    )
+
+
 def prepare_batch(
     pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ):
@@ -158,7 +183,8 @@ def verify_batch(
 ) -> np.ndarray:
     """Verify a batch; returns (n,) bool numpy array of per-signature results."""
     arrays, n, structural = prepare_batch(pubs, msgs, sigs)
+    kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
     accept = np.asarray(
-        _verify_kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
+        kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
     )
     return (accept & structural)[:n]
