@@ -85,6 +85,85 @@ class Ed25519PrivKey:
         return self.seed
 
 
+@dataclass(frozen=True)
+class Bls12381PubKey:
+    """96-byte uncompressed-G1 public key (min-pubkey-size convention).
+
+    Reference: crypto/bls12381/key_bls12381.go:150-216 (blst-backed) and
+    const.go PubKeySize=96; implementation is the from-spec pure-python
+    pairing in cometbft_tpu.crypto.bls12381."""
+
+    data: bytes
+
+    type_ = BLS12381_KEY_TYPE
+
+    def __post_init__(self):
+        if len(self.data) != 96:
+            raise ValueError("bls12_381 pubkey must be 96 bytes")
+
+    def address(self) -> bytes:
+        addr = self.__dict__.get("_addr")
+        if addr is None:
+            addr = tmhash.sum_truncated(self.data)
+            self.__dict__["_addr"] = addr
+        return addr
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        from cometbft_tpu.crypto import bls12381 as _bls
+
+        if len(sig) != _bls.SIGNATURE_SIZE:
+            return False
+        return _bls.verify(self.data, msg, sig)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+
+@dataclass(frozen=True)
+class Bls12381PrivKey:
+    """32-byte BLS secret scalar (big-endian), reference PrivKey.Bytes."""
+
+    data: bytes
+
+    type_ = BLS12381_KEY_TYPE
+
+    @staticmethod
+    def generate() -> "Bls12381PrivKey":
+        from cometbft_tpu.crypto import bls12381 as _bls
+
+        return Bls12381PrivKey(_bls.sk_to_bytes(_bls.gen_privkey()))
+
+    @staticmethod
+    def from_secret(secret: bytes) -> "Bls12381PrivKey":
+        """Reference GenPrivKeyFromSecret (key_bls12381.go:66-74)."""
+        from cometbft_tpu.crypto import bls12381 as _bls
+
+        return Bls12381PrivKey(
+            _bls.sk_to_bytes(_bls.gen_privkey_from_secret(secret))
+        )
+
+    def _sk(self) -> int:
+        from cometbft_tpu.crypto import bls12381 as _bls
+
+        sk = _bls.sk_from_bytes(self.data)
+        if sk is None:
+            raise ValueError("invalid bls12_381 private key bytes")
+        return sk
+
+    def pub_key(self) -> Bls12381PubKey:
+        from cometbft_tpu.crypto import bls12381 as _bls
+
+        return Bls12381PubKey(_bls.pubkey(self._sk()))
+
+    def sign(self, msg: bytes) -> bytes:
+        from cometbft_tpu.crypto import bls12381 as _bls
+
+        return _bls.sign(self._sk(), msg)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+
 def pub_key_from_type(key_type: str, data: bytes):
     if key_type == ED25519_KEY_TYPE:
         return Ed25519PubKey(data)
@@ -92,6 +171,8 @@ def pub_key_from_type(key_type: str, data: bytes):
         from cometbft_tpu.crypto.secp256k1 import Secp256k1PubKey
 
         return Secp256k1PubKey(data)
+    if key_type == BLS12381_KEY_TYPE:
+        return Bls12381PubKey(data)
     raise ValueError(f"unsupported key type: {key_type}")
 
 
@@ -103,10 +184,12 @@ def priv_key_generate(key_type: str = ED25519_KEY_TYPE):
         from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
 
         return Secp256k1PrivKey.generate()
+    if key_type == BLS12381_KEY_TYPE:
+        return Bls12381PrivKey.generate()
     raise ValueError(f"unsupported key type: {key_type}")
 
 
 def supported_key_types() -> list[str]:
-    """bls12_381 is gated off (reference: build-tag gated blst backend,
-    crypto/bls12381/key.go Enabled=false without the tag)."""
-    return [ED25519_KEY_TYPE, SECP256K1_KEY_TYPE]
+    """All three key types the reference registers (internal/keytypes
+    with the bls12381 build tag enabled; crypto/bls12381/const.go)."""
+    return [ED25519_KEY_TYPE, SECP256K1_KEY_TYPE, BLS12381_KEY_TYPE]

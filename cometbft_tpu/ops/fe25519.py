@@ -351,7 +351,9 @@ def freeze(a: F) -> jnp.ndarray:
     limbs.  Used for equality / parity / encoding only."""
     a = carry(a)
     pad, pad_max = _nonneg_pad(a.lo)
-    v = a.v + _rows_const(pad, a.v.shape[1])
+    # width-1 outside kernels (free broadcast), tile width inside —
+    # the same rule const() follows
+    v = a.v + _rows_const(pad, _CONST_BATCH[-1])
     hi = a.hi + pad_max
     assert a.lo + int(pad.min()) >= 0
     # parallel floor-carries down to the fixpoint (limbs <= MASK + FOLD)

@@ -115,6 +115,8 @@ def verify_core_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok,
     """
     batch = a_bytes.shape[0]
     tile = min(tile, batch)
+    while batch % tile:  # honor any batch size, not just bucket multiples
+        tile -= 1
     ya, sa = fe.unpack255(a_bytes)
     yr, sr = fe.unpack255(r_bytes)
     dig_s = fe.nibbles_msb_first(s_bytes)

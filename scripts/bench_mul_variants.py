@@ -61,7 +61,8 @@ fb = chain(mul_rows)
 ra, rb = np.asarray(fa(v)), np.asarray(fb(v))
 ia = [fe.int_of_limbs(ra[:, i]) % fe.P_INT for i in range(4)]
 ib = [fe.int_of_limbs(rb[:, i]) % fe.P_INT for i in range(4)]
-print("variants agree:", ia == ib)
+assert ia == ib, f"mul variants DIVERGE: {ia} != {ib}"
+print("variants agree: True")
 timed(fa, v, "skew")
 timed(fb, v, "rows")
 timed(fa, v, "skew(2)")

@@ -1,0 +1,159 @@
+"""BLS12-381 correctness gates.
+
+No external interop vectors are fetchable offline, so correctness rests on
+algebraic invariants that a wrong pairing/hash cannot satisfy:
+
+  * pairing bilinearity e(aP, bQ) == e(P,Q)^(ab) and non-degeneracy —
+    these uniquely pin the reduced Tate/ate pairing up to exponent;
+  * hash_to_g2 outputs on-curve, in the r-torsion subgroup, deterministic,
+    and distinct across messages (collision would break SSWU/iso);
+  * serialization round-trips in the ZCash flag format the reference's
+    blst uses;
+  * sign/verify/aggregate semantics matching
+    /root/reference/crypto/bls12381/key_bls12381.go:108-188 and its tests
+    (key_test.go: tampered-signature rejection, wrong-message rejection).
+"""
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as bls
+from cometbft_tpu.crypto import keys as ck
+
+
+def test_generators_valid():
+    assert bls.E1.on_curve(bls.G1_GEN)
+    assert bls._g1_subgroup(bls.G1_GEN)
+    assert bls.E2.on_curve(bls.G2_GEN)
+    assert bls._g2_subgroup(bls.G2_GEN)
+
+
+def test_pairing_bilinear_and_nondegenerate():
+    a, b = 5, 9
+    e_ab = bls.pairing(
+        bls.E1.mul_scalar(bls.G1_GEN, a), bls.E2.mul_scalar(bls.G2_GEN, b)
+    )
+    e_prod = bls.pairing(bls.E1.mul_scalar(bls.G1_GEN, a * b), bls.G2_GEN)
+    e_pow = bls._f12_pow(bls.pairing(bls.G1_GEN, bls.G2_GEN), a * b)
+    assert e_ab == e_prod == e_pow
+    assert e_ab != bls.F12_ONE
+
+
+def test_hash_to_g2_properties():
+    h1 = bls.hash_to_g2(b"msg-1")
+    h2 = bls.hash_to_g2(b"msg-1")
+    h3 = bls.hash_to_g2(b"msg-2")
+    assert bls.E2.on_curve(h1)
+    assert bls._g2_subgroup(h1)
+    assert bls._g2_affine(h1) == bls._g2_affine(h2)
+    assert bls._g2_affine(h1) != bls._g2_affine(h3)
+
+
+def test_sign_verify_and_rejections():
+    sk = bls.gen_privkey_from_secret(b"secret seed material")
+    pub = bls.pubkey(sk)
+    assert len(pub) == bls.PUB_KEY_SIZE
+    msg = b'{"type":2,"height":7,"round":0}'
+    sig = bls.sign(sk, msg)
+    assert len(sig) == bls.SIGNATURE_SIZE
+    assert bls.verify(pub, msg, sig)
+    # tampered signature byte (reference key_test.go:103-105)
+    bad = bytearray(sig)
+    bad[7] ^= 1
+    assert not bls.verify(pub, msg, bytes(bad))
+    assert not bls.verify(pub, msg + b"!", sig)
+    # wrong pubkey
+    pub2 = bls.pubkey(bls.gen_privkey_from_secret(b"other"))
+    assert not bls.verify(pub2, msg, sig)
+    # garbage inputs must not raise
+    assert not bls.verify(b"\x00" * 96, msg, sig)
+    assert not bls.verify(pub, msg, b"\x00" * 96)
+
+
+def test_infinite_pubkey_rejected():
+    inf = bytearray(96)
+    inf[0] = 0x40
+    assert not bls.pubkey_validate(bytes(inf))
+    assert not bls.verify(bytes(inf), b"m", bls.sign(1234567, b"m"))
+
+
+def test_serialization_round_trips():
+    sk = bls.gen_privkey_from_secret(b"ser")
+    pub = bls.pubkey(sk)
+    pt = bls.g1_deserialize(pub)
+    assert pt is not None and bls.g1_serialize(pt) == pub
+    sig = bls.sign(sk, b"x")
+    s = bls.g2_uncompress(sig)
+    assert s is not None and bls.g2_compress(s) == sig
+    # sk round trip
+    assert bls.sk_from_bytes(bls.sk_to_bytes(sk)) == sk
+    assert bls.sk_from_bytes(b"\x00" * 32) is None  # zero key invalid
+
+
+def test_aggregate():
+    sks = [bls.gen_privkey_from_secret(b"agg-%d" % i) for i in range(3)]
+    msgs = [b"vote-%d" % i for i in range(3)]
+    sigs = [bls.sign(s, m) for s, m in zip(sks, msgs)]
+    agg = bls.aggregate_signatures(sigs)
+    pubs = [bls.pubkey(s) for s in sks]
+    assert bls.aggregate_verify(pubs, msgs, agg)
+    assert not bls.aggregate_verify(pubs, list(reversed(msgs)), agg)
+    # basic (NUL) scheme: repeated messages must be rejected
+    assert not bls.aggregate_verify(pubs, [b"same"] * 3, agg)
+
+
+def test_key_registry_integration():
+    priv = ck.priv_key_generate(ck.BLS12381_KEY_TYPE)
+    pub = priv.pub_key()
+    assert pub.type_ == "bls12_381"
+    assert len(pub.address()) == 20
+    msg = b"registry vote"
+    sig = priv.sign(msg)
+    assert pub.verify_signature(msg, sig)
+    assert not pub.verify_signature(msg + b"!", sig)
+    # round trip through the generic constructor (genesis path)
+    pub2 = ck.pub_key_from_type(ck.BLS12381_KEY_TYPE, pub.bytes())
+    assert pub2.verify_signature(msg, sig)
+    assert "bls12_381" in ck.supported_key_types()
+
+
+def test_genesis_accepts_bls_validator():
+    import base64
+    import json
+
+    from cometbft_tpu.types import genesis as g
+
+    priv = ck.priv_key_generate(ck.BLS12381_KEY_TYPE)
+    pub = priv.pub_key()
+    doc = {
+        "chain_id": "bls-chain",
+        "genesis_time": {"seconds": 1750000000, "nanos": 0},
+        "consensus_params": {
+            "validator": {"pub_key_types": ["bls12_381"]}
+        },
+        "validators": [
+            {
+                "pub_key": {
+                    "type": "bls12_381",
+                    "value": base64.b64encode(pub.bytes()).decode(),
+                },
+                "power": "10",
+                "name": "v0",
+            }
+        ],
+        "app_hash": "",
+    }
+    gd = g.GenesisDoc.from_json(json.dumps(doc))
+    assert gd.validators[0].pub_key.type_ == "bls12_381"
+    assert gd.validators[0].pub_key.bytes() == pub.bytes()
+
+
+def test_keygen_from_secret_hashes_non32():
+    # reference GenPrivKeyFromSecret sha256's non-32-byte secrets
+    import hashlib
+
+    s = b"short"
+    assert bls.gen_privkey_from_secret(s) == bls.keygen(
+        hashlib.sha256(s).digest()
+    )
+    s32 = bytes(range(32))
+    assert bls.gen_privkey_from_secret(s32) == bls.keygen(s32)
