@@ -344,7 +344,6 @@ class _Curve:
         X3 = self.sub(self.sub(self.sq(rr), J), self.add(V, V))
         S1J = self.mul(S1, J)
         Y3 = self.sub(self.mul(rr, self.sub(V, X3)), self.add(S1J, S1J))
-        Z3 = self.mul(self.mul(self.add(Z1, Z2), self.add(Z1, Z2)), H)
         Z3 = self.mul(self.sub(self.sq(self.add(Z1, Z2)), self.add(Z1Z1, Z2Z2)), H)
         return (X3, Y3, Z3)
 

@@ -178,6 +178,33 @@ class KVTxIndexer:
             sets.append((_event_key(_TX_EVENT, tag, value, height, index), h))
         self._db.write_batch(sets, [])
 
+    def prune(self, retain_height: int) -> int:
+        """Drop all tx index entries below ``retain_height`` (background
+        pruner; reference: state/txindex pruning via state/pruner.go).
+        Returns the number of transactions un-indexed."""
+        event_deletes: list[bytes] = []
+        primary_candidates: set[bytes] = set()
+        for key, val in self._db.iterate(_TX_EVENT, _TX_EVENT + b"\xff"):
+            # key tail: 8-byte big-endian height + 4-byte index
+            if len(key) < 12:
+                continue
+            height = struct.unpack(">q", key[-12:-4])[0]
+            if height < retain_height:
+                event_deletes.append(key)
+                if val:
+                    primary_candidates.add(val)
+        # Only drop a primary record if its (latest) indexed height is
+        # itself below the retain height — the same tx bytes may have been
+        # re-committed at a higher height, overwriting the record.
+        primary_deletes = []
+        for h in sorted(primary_candidates):
+            rec = self.get(h)
+            if rec is not None and rec.height < retain_height:
+                primary_deletes.append(_TX_PRIMARY + h)
+        if event_deletes or primary_deletes:
+            self._db.write_batch([], event_deletes + primary_deletes)
+        return len(primary_deletes)
+
     def get(self, hash_: bytes) -> Optional[TxResult]:
         raw = self._db.get(_TX_PRIMARY + hash_)
         return TxResult.decode(raw) if raw else None
@@ -231,6 +258,19 @@ class KVBlockIndexer:
         for tag, value in _indexed_tags(events):
             sets.append((_event_key(_BLOCK_EVENT, tag, value, height), b""))
         self._db.write_batch(sets, [])
+
+    def prune(self, retain_height: int) -> int:
+        """Drop all block index entries below ``retain_height``."""
+        deletes: list[bytes] = []
+        for key, _val in self._db.iterate(_BLOCK_EVENT, _BLOCK_EVENT + b"\xff"):
+            if len(key) < 8:
+                continue
+            height = struct.unpack(">q", key[-8:])[0]
+            if height < retain_height:
+                deletes.append(key)
+        if deletes:
+            self._db.write_batch([], deletes)
+        return len(deletes)
 
     def search(self, query: Query) -> list[int]:
         result_set: Optional[set[int]] = None

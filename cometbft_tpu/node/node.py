@@ -109,7 +109,12 @@ class Node(BaseService):
             )
 
         # -- ABCI proxy (reference: node/node.go:359) -----------------------
-        if config.base.abci == "builtin":
+        if config.base.abci == "grpc":
+            self.app = None
+            creator = remote_client_creator(
+                config.base.proxy_app, transport="grpc"
+            )
+        elif config.base.abci == "builtin":
             self.app = _builtin_app(config.base.proxy_app)
             creator = local_client_creator(self.app)
         else:
@@ -189,6 +194,8 @@ class Node(BaseService):
             event_bus=self.event_bus,
             logger=self.logger.with_(module="state"),
         )
+        # restore data-companion retain heights (survive restarts)
+        self.state_store.load_retain_heights(self.block_exec._retain)
 
         # -- consensus ------------------------------------------------------
         wal_path = os.path.join(home, config.consensus.wal_file)
@@ -361,6 +368,20 @@ class Node(BaseService):
     def on_start(self) -> None:
         if self.indexer_service is not None:
             self.indexer_service.start()
+        # background pruner (reference: node/node.go createPruner; the
+        # executor records retain heights, this service acts on them)
+        from cometbft_tpu.state.pruner import Pruner
+
+        self.pruner = Pruner(
+            self.block_exec._retain,
+            self.block_store,
+            self.state_store,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
+            interval_s=2.0,
+            logger=self.logger.with_(module="pruner"),
+        )
+        self.pruner.start()
         threading.Thread(
             target=self._metrics_sampler, name="metrics-sampler", daemon=True
         ).start()
@@ -506,6 +527,8 @@ class Node(BaseService):
             self.addr_book.save()
         if self.indexer_service is not None:
             self.indexer_service.stop()
+        if getattr(self, "pruner", None) is not None:
+            self.pruner.stop()
         if self._signer_endpoint is not None:
             self._signer_endpoint.stop()
         if self.metrics_server is not None:

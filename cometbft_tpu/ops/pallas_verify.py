@@ -115,13 +115,21 @@ def verify_core_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok,
     """
     batch = a_bytes.shape[0]
     tile = min(tile, batch)
-    while batch % tile:  # honor any batch size, not just bucket multiples
-        tile -= 1
+    pad = (-batch) % tile
+    if pad:
+        # pad to a tile multiple with s_ok=0 lanes (rejected by
+        # construction) — full lane occupancy for any batch size
+        zeros2 = jnp.zeros((pad, 32), a_bytes.dtype)
+        a_bytes = jnp.concatenate([a_bytes, zeros2])
+        r_bytes = jnp.concatenate([r_bytes, zeros2])
+        s_bytes = jnp.concatenate([s_bytes, zeros2])
+        m_bytes = jnp.concatenate([m_bytes, zeros2])
+        s_ok = jnp.concatenate([s_ok, jnp.zeros((pad,), s_ok.dtype)])
     ya, sa = fe.unpack255(a_bytes)
     yr, sr = fe.unpack255(r_bytes)
     dig_s = fe.nibbles_msb_first(s_bytes)
     dig_m = fe.nibbles_msb_first(m_bytes)
-    out = _build(batch, tile)(
+    out = _build(batch + pad, tile)(
         ya.v,
         sa[None, :].astype(jnp.int32),
         yr.v,
@@ -131,4 +139,4 @@ def verify_core_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok,
         s_ok[None, :].astype(jnp.int32),
         jnp.asarray(ep._niels_base_table()),
     )
-    return out[0] != 0
+    return out[0, :batch] != 0

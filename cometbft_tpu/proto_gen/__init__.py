@@ -1,0 +1,13 @@
+"""Generated protobuf modules (see scripts/gen_proto.sh).
+
+The generated files import each other with absolute ``cometbft.*`` module
+paths (protoc's convention), so this package prepends itself to sys.path
+on first import.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+if _here not in sys.path:
+    sys.path.insert(0, _here)

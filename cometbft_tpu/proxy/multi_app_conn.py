@@ -29,8 +29,15 @@ def local_client_creator(app: Application) -> ClientCreator:
     return create
 
 
-def remote_client_creator(address: str) -> ClientCreator:
+def remote_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+    """Socket (length-prefixed framing) or gRPC flavor, per the reference's
+    abci config key (abci/client: socketClient vs grpcClient)."""
+
     def create() -> Client:
+        if transport == "grpc" or address.startswith("grpc://"):
+            from cometbft_tpu.abci.grpc_abci import GRPCClient
+
+            return GRPCClient(address)
         return SocketClient(address)
 
     return create

@@ -1,34 +1,42 @@
-"""gRPC services (reference: rpc/grpc/server/services/).
+"""gRPC services with the reference's protobuf wire format.
 
-Four services on the public endpoint — version, block, block-results —
-plus the privileged pruning service (the data-companion API, reference:
-rpc/grpc/server/services/pruningservice).  Implemented with grpc's
-generic handlers over JSON payloads: same service/method names as the
-reference's proto packages, JSON instead of binary proto on the wire
-(this framework's RPC schema is self-defined; see libs/protoenc).
+Four services (reference: rpc/grpc/server/services/): version, block,
+block-results on the public endpoint, plus the privileged pruning service
+(the data-companion API).  Requests and responses are the real protobuf
+messages from proto/cometbft/services/* — any client built against the
+reference's .proto files (grpcurl, Go/Rust data companions) can connect.
+
+Method handlers are registered through grpc's generic-handler API with
+protobuf (de)serializers; service code generation is not required.
 """
 
 from __future__ import annotations
 
-import json
 from concurrent import futures
 from typing import Optional
 
+import cometbft_tpu.proto_gen  # noqa: F401 — sys.path hook for cometbft.*
+
+from cometbft.services.block.v1 import block_pb2 as block_svc_pb
+from cometbft.services.block_results.v1 import (
+    block_results_pb2 as block_results_svc_pb,
+)
+from cometbft.services.pruning.v1 import pruning_pb2 as pruning_pb
+from cometbft.services.version.v1 import version_pb2 as version_pb
+
 from cometbft_tpu.libs import log as liblog
-from cometbft_tpu.version import BLOCK_PROTOCOL, CMT_SEMVER, P2P_PROTOCOL
+from cometbft_tpu.rpc import pb_convert as conv
+from cometbft_tpu.version import (
+    ABCI_SEMVER,
+    BLOCK_PROTOCOL,
+    CMT_SEMVER,
+    P2P_PROTOCOL,
+)
 
 _VERSION_SVC = "cometbft.services.version.v1.VersionService"
 _BLOCK_SVC = "cometbft.services.block.v1.BlockService"
 _BLOCK_RESULTS_SVC = "cometbft.services.block_results.v1.BlockResultsService"
 _PRUNING_SVC = "cometbft.services.pruning.v1.PruningService"
-
-
-def _json_ser(obj) -> bytes:
-    return json.dumps(obj).encode()
-
-
-def _json_deser(raw: bytes):
-    return json.loads(raw.decode()) if raw else {}
 
 
 class GRPCServer:
@@ -58,88 +66,243 @@ class GRPCServer:
         addr = laddr.replace("tcp://", "")
         self.bound_port = self._server.add_insecure_port(addr)
 
-    # -- services ----------------------------------------------------------
+    # -- helpers ------------------------------------------------------------
 
-    def _unary(self, grpc, fn):
+    @staticmethod
+    def _unary(grpc, fn, req_cls, resp_cls):
         return grpc.unary_unary_rpc_method_handler(
-            fn, request_deserializer=_json_deser, response_serializer=_json_ser
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
         )
+
+    @staticmethod
+    def _stream(grpc, fn, req_cls, resp_cls):
+        return grpc.unary_stream_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=resp_cls.SerializeToString,
+        )
+
+    # -- services -----------------------------------------------------------
 
     def _version_service(self, grpc):
         def get_version(request, context):
-            return {
-                "node": CMT_SEMVER,
-                "abci": "2.2.0",
-                "p2p": str(P2P_PROTOCOL),
-                "block": str(BLOCK_PROTOCOL),
-            }
+            return version_pb.GetVersionResponse(
+                node=CMT_SEMVER,
+                abci=ABCI_SEMVER,
+                p2p=P2P_PROTOCOL,
+                block=BLOCK_PROTOCOL,
+            )
 
         return grpc.method_handlers_generic_handler(
-            _VERSION_SVC, {"GetVersion": self._unary(grpc, get_version)}
+            _VERSION_SVC,
+            {
+                "GetVersion": self._unary(
+                    grpc,
+                    get_version,
+                    version_pb.GetVersionRequest,
+                    version_pb.GetVersionResponse,
+                )
+            },
         )
 
     def _block_service(self, grpc):
-        from cometbft_tpu.rpc.core import _block_json, _block_id_json
-
-        def get_block(request, context):
-            h = int(request.get("height", 0)) or self.node.block_store.height()
+        def get_by_height(request, context):
+            h = request.height or self.node.block_store.height()
             block = self.node.block_store.load_block(h)
             meta = self.node.block_store.load_block_meta(h)
             if block is None or meta is None:
                 context.abort(grpc.StatusCode.NOT_FOUND, f"block {h} not found")
-            return {
-                "block_id": _block_id_json(meta.block_id),
-                "block": _block_json(block),
-            }
+            resp = block_svc_pb.GetByHeightResponse()
+            resp.block_id.CopyFrom(conv.block_id_pb(meta.block_id))
+            resp.block.CopyFrom(conv.block_pb(block))
+            return resp
 
         def get_latest_height(request, context):
-            # single-shot variant of the reference's streaming endpoint
-            return {"height": str(self.node.block_store.height())}
+            # Stream of height updates (reference: blockservice
+            # GetLatestHeight subscribes to the event bus).  Emit the
+            # current height, then follow new blocks until the client
+            # disconnects.
+            import queue as _queue
+
+            from cometbft_tpu.libs.pubsub import Query
+
+            yield block_svc_pb.GetLatestHeightResponse(
+                height=self.node.block_store.height()
+            )
+            bus = getattr(self.node, "event_bus", None)
+            if bus is None:
+                return
+            sub_id = "grpc-latest-height-%d" % id(context)
+            try:
+                sub = bus.subscribe(
+                    sub_id, Query.parse("tm.event='NewBlock'"), capacity=128
+                )
+            except Exception:
+                return
+            try:
+                while context.is_active() and not sub.canceled.is_set():
+                    try:
+                        sub.out.get(timeout=1.0)
+                    except _queue.Empty:
+                        continue
+                    yield block_svc_pb.GetLatestHeightResponse(
+                        height=self.node.block_store.height()
+                    )
+            finally:
+                try:
+                    bus.unsubscribe_all(sub_id)
+                except Exception:
+                    pass
 
         return grpc.method_handlers_generic_handler(
             _BLOCK_SVC,
             {
-                "GetByHeight": self._unary(grpc, get_block),
-                "GetLatestHeight": self._unary(grpc, get_latest_height),
+                "GetByHeight": self._unary(
+                    grpc,
+                    get_by_height,
+                    block_svc_pb.GetByHeightRequest,
+                    block_svc_pb.GetByHeightResponse,
+                ),
+                "GetLatestHeight": self._stream(
+                    grpc,
+                    get_latest_height,
+                    block_svc_pb.GetLatestHeightRequest,
+                    block_svc_pb.GetLatestHeightResponse,
+                ),
             },
         )
 
     def _block_results_service(self, grpc):
-        from cometbft_tpu.rpc.core import Environment
+        from cometbft_tpu.state.execution import fbr_from_json
 
         def get_block_results(request, context):
-            env = Environment(self.node)
-            h = int(request.get("height", 0)) or None
-            try:
-                return env.block_results(h)
-            except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            h = request.height or self.node.block_store.height()
+            raw = self.node.state_store.load_finalize_block_response(h)
+            if raw is None:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND, f"no results for height {h}"
+                )
+            res = fbr_from_json(raw)
+            resp = block_results_svc_pb.GetBlockResultsResponse(
+                height=h, app_hash=res.app_hash
+            )
+            for r in res.tx_results:
+                resp.tx_results.add().CopyFrom(conv.exec_tx_result_pb(r))
+            for e in res.events:
+                resp.finalize_block_events.add().CopyFrom(conv.event_pb(e))
+            for v in res.validator_updates:
+                resp.validator_updates.add().CopyFrom(
+                    conv.validator_update_pb(v)
+                )
+            conv.params_to_pb(
+                resp.consensus_param_updates, res.consensus_param_updates
+            )
+            return resp
 
         return grpc.method_handlers_generic_handler(
             _BLOCK_RESULTS_SVC,
-            {"GetBlockResults": self._unary(grpc, get_block_results)},
+            {
+                "GetBlockResults": self._unary(
+                    grpc,
+                    get_block_results,
+                    block_results_svc_pb.GetBlockResultsRequest,
+                    block_results_svc_pb.GetBlockResultsResponse,
+                )
+            },
         )
 
     def _pruning_service(self, grpc):
-        """Data-companion retain heights (reference: pruningservice)."""
+        """Data-companion retain heights (reference: pruningservice).
+        Every setter persists the heights so a restart cannot drop a
+        companion's hold on data it has not yet ingested."""
+        node = self.node
 
-        def set_block_retain_height(request, context):
-            h = int(request.get("height", 0))
-            self.node.block_exec._retain.companion_retain = h
-            return {}
+        def _persist():
+            try:
+                node.state_store.save_retain_heights(node.block_exec._retain)
+            except Exception:  # noqa: BLE001 — persistence is best-effort
+                self.logger.error("failed to persist retain heights")
 
-        def get_block_retain_height(request, context):
-            r = self.node.block_exec._retain
-            return {
-                "app_retain_height": str(r.app_retain),
-                "pruning_service_retain_height": str(r.companion_retain),
-            }
+        def set_block(request, context):
+            node.block_exec._retain.companion_retain = request.height
+            _persist()
+            return pruning_pb.SetBlockRetainHeightResponse()
+
+        def get_block(request, context):
+            r = node.block_exec._retain
+            return pruning_pb.GetBlockRetainHeightResponse(
+                app_retain_height=r.app_retain,
+                pruning_service_retain_height=r.companion_retain,
+            )
+
+        def set_block_results(request, context):
+            node.block_exec._retain.companion_results_retain = request.height
+            _persist()
+            return pruning_pb.SetBlockResultsRetainHeightResponse()
+
+        def get_block_results(request, context):
+            r = node.block_exec._retain
+            return pruning_pb.GetBlockResultsRetainHeightResponse(
+                pruning_service_retain_height=getattr(
+                    r, "companion_results_retain", 0
+                )
+            )
+
+        def set_tx_indexer(request, context):
+            node.block_exec._retain.tx_index_retain = request.height
+            _persist()
+            return pruning_pb.SetTxIndexerRetainHeightResponse()
+
+        def get_tx_indexer(request, context):
+            return pruning_pb.GetTxIndexerRetainHeightResponse(
+                height=getattr(node.block_exec._retain, "tx_index_retain", 0)
+            )
+
+        def set_block_indexer(request, context):
+            node.block_exec._retain.block_index_retain = request.height
+            _persist()
+            return pruning_pb.SetBlockIndexerRetainHeightResponse()
+
+        def get_block_indexer(request, context):
+            return pruning_pb.GetBlockIndexerRetainHeightResponse(
+                height=getattr(
+                    node.block_exec._retain, "block_index_retain", 0
+                )
+            )
+
+        def u(fn, name):
+            return self._unary(
+                grpc,
+                fn,
+                getattr(pruning_pb, name + "Request"),
+                getattr(pruning_pb, name + "Response"),
+            )
 
         return grpc.method_handlers_generic_handler(
             _PRUNING_SVC,
             {
-                "SetBlockRetainHeight": self._unary(grpc, set_block_retain_height),
-                "GetBlockRetainHeight": self._unary(grpc, get_block_retain_height),
+                "SetBlockRetainHeight": u(set_block, "SetBlockRetainHeight"),
+                "GetBlockRetainHeight": u(get_block, "GetBlockRetainHeight"),
+                "SetBlockResultsRetainHeight": u(
+                    set_block_results, "SetBlockResultsRetainHeight"
+                ),
+                "GetBlockResultsRetainHeight": u(
+                    get_block_results, "GetBlockResultsRetainHeight"
+                ),
+                "SetTxIndexerRetainHeight": u(
+                    set_tx_indexer, "SetTxIndexerRetainHeight"
+                ),
+                "GetTxIndexerRetainHeight": u(
+                    get_tx_indexer, "GetTxIndexerRetainHeight"
+                ),
+                "SetBlockIndexerRetainHeight": u(
+                    set_block_indexer, "SetBlockIndexerRetainHeight"
+                ),
+                "GetBlockIndexerRetainHeight": u(
+                    get_block_indexer, "GetBlockIndexerRetainHeight"
+                ),
             },
         )
 
@@ -153,16 +316,16 @@ class GRPCServer:
 
 
 def make_client_channel(target: str):
-    """A channel whose calls use the same JSON codec (for tests/tools)."""
     import grpc
 
     return grpc.insecure_channel(target.replace("tcp://", ""))
 
 
-def grpc_call(channel, service: str, method: str, request: dict) -> dict:
+def grpc_unary(channel, service: str, method: str, request, resp_cls):
+    """One protobuf unary call (client side of the generic handlers)."""
     callable_ = channel.unary_unary(
         f"/{service}/{method}",
-        request_serializer=_json_ser,
-        response_deserializer=_json_deser,
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString,
     )
     return callable_(request)

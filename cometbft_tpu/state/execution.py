@@ -136,8 +136,15 @@ def validate_validator_updates(
 
 @dataclass
 class _PrunerHeights:
+    """Retain heights influencing pruning (reference: state/pruner.go —
+    app + data-companion block heights, plus companion-set block-results
+    and indexer retain heights served by the pruning gRPC service)."""
+
     app_retain: int = 0
     companion_retain: int = 0
+    companion_results_retain: int = 0
+    tx_index_retain: int = 0
+    block_index_retain: int = 0
 
 
 class BlockExecutor:
@@ -355,7 +362,8 @@ class BlockExecutor:
         self.state_store.save(new_state)
         fail_point(4)
 
-        self._prune(new_state)
+        # pruning happens in the background Pruner service (state/pruner.py)
+        # off the commit path, honoring the recorded retain heights
         self._fire_events(block, block_id, res, val_updates)
         return new_state
 
@@ -409,21 +417,6 @@ class BlockExecutor:
             version_app=state.version_app,
         )
 
-    def _prune(self, state: State) -> None:
-        """Prune to the lower of the app's and the data companion's retain
-        heights (reference: state/pruner.go — both consumers must be done
-        with a block before it goes)."""
-        retain = self._retain.app_retain
-        if self._retain.companion_retain > 0:
-            retain = (
-                min(retain, self._retain.companion_retain)
-                if retain > 0
-                else self._retain.companion_retain
-            )
-        if retain > 0 and retain > self.block_store.base():
-            pruned = self.block_store.prune_blocks(retain)
-            if pruned and self.logger:
-                self.logger.debug("pruned blocks", pruned=pruned, retain=retain)
 
     def _fire_events(self, block: Block, block_id: BlockID, res, val_updates):
         """Reference: state/execution.go:706 fireEvents."""

@@ -98,13 +98,60 @@ class StateStore:
     def load_finalize_block_response(self, height: int) -> Optional[bytes]:
         return self._db.get(_k_abci_resp(height))
 
+    def delete_finalize_block_response(self, height: int) -> bool:
+        """Used by the background pruner (results retain height); returns
+        True if an entry existed."""
+        key = _k_abci_resp(height)
+        if self._db.get(key) is None:
+            return False
+        self._db.delete(key)
+        return True
+
+    def save_retain_heights(self, retain) -> None:
+        """Persist data-companion retain heights so they survive restarts
+        (reference persists them in the state store for the same reason:
+        a companion's hold on blocks must not be lost on reboot)."""
+        import json as _json
+
+        self._db.set(
+            b"companion_retain",
+            _json.dumps(
+                {
+                    "companion_retain": retain.companion_retain,
+                    "companion_results_retain": retain.companion_results_retain,
+                    "tx_index_retain": retain.tx_index_retain,
+                    "block_index_retain": retain.block_index_retain,
+                }
+            ).encode(),
+        )
+
+    def load_retain_heights(self, retain) -> None:
+        """Restore persisted companion retain heights into ``retain``."""
+        import json as _json
+
+        raw = self._db.get(b"companion_retain")
+        if raw is None:
+            return
+        doc = _json.loads(raw.decode())
+        retain.companion_retain = int(doc.get("companion_retain", 0))
+        retain.companion_results_retain = int(
+            doc.get("companion_results_retain", 0)
+        )
+        retain.tx_index_retain = int(doc.get("tx_index_retain", 0))
+        retain.block_index_retain = int(doc.get("block_index_retain", 0))
+
     # -- pruning ----------------------------------------------------------
 
-    def prune_states(self, from_height: int, to_height: int) -> int:
-        """Prune [from, to) validator/params/response entries
-        (reference: state/store.go:427 PruneStates)."""
+    def prune_states(
+        self, from_height: int, to_height: int, include_responses: bool = True
+    ) -> int:
+        """Prune [from, to) validator/params (and, unless a data companion
+        governs them separately, finalize-block response) entries
+        (reference: state/store.go:427 PruneStates / PruneABCIResponses)."""
         deletes = []
         for h in range(from_height, to_height):
-            deletes += [_k_vals(h), _k_params(h), _k_abci_resp(h)]
+            deletes += [_k_vals(h), _k_params(h)]
+            if include_responses:
+                deletes.append(_k_abci_resp(h))
         self._db.write_batch([], deletes)
         return to_height - from_height

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Regenerate cometbft_tpu/proto_gen from proto/ (protoc python_out only;
+# services are registered via grpc generic handlers, no grpc plugin
+# needed).  Generated files are committed so imports need no build step.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=cometbft_tpu/proto_gen
+rm -rf "$OUT"
+mkdir -p "$OUT"
+protoc -I proto --python_out="$OUT" $(find proto -name '*.proto')
+# package markers so the generated tree imports cleanly
+find "$OUT" -type d -exec touch {}/__init__.py \;
+cat > "$OUT/__init__.py" <<'EOF'
+"""Generated protobuf modules (see scripts/gen_proto.sh).
+
+The generated files import each other with absolute ``cometbft.*`` module
+paths (protoc's convention), so this package prepends itself to sys.path
+on first import.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+EOF
+echo "generated $(find "$OUT" -name '*_pb2.py' | wc -l) modules"

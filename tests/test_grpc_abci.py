@@ -1,0 +1,102 @@
+"""gRPC ABCI flavor: serve the kvstore app over the real
+cometbft.abci.v1.ABCIService protobuf schema and drive it through
+GRPCClient — the same contract the reference's grpc client/server pair
+speaks (abci/client/grpc_client.go, abci/server/grpc_server.go)."""
+
+import pytest
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.abci.grpc_abci import GRPCABCIServer, GRPCClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+
+@pytest.fixture()
+def grpc_app():
+    app = KVStoreApplication()
+    server = GRPCABCIServer(app, "127.0.0.1:0")
+    server.start()
+    client = GRPCClient(f"127.0.0.1:{server.bound_port}")
+    yield app, client
+    client.close()
+    server.stop()
+
+
+def test_echo_info_flush(grpc_app):
+    _, client = grpc_app
+    assert client.echo("hello").message == "hello"
+    client.flush()
+    info = client.info(at.InfoRequest(version="1.0.0"))
+    assert info.last_block_height == 0
+
+
+def test_full_block_lifecycle(grpc_app):
+    _, client = grpc_app
+    client.init_chain(
+        at.InitChainRequest(
+            chain_id="grpc-chain",
+            initial_height=1,
+            consensus_params={"block": {"max_bytes": 1048576, "max_gas": -1}},
+        )
+    )
+    tx = b"grpckey=grpcval"
+    chk = client.check_tx(at.CheckTxRequest(tx=tx))
+    assert chk.code == at.CODE_TYPE_OK
+
+    prep = client.prepare_proposal(
+        at.PrepareProposalRequest(max_tx_bytes=1 << 20, txs=[tx], height=1)
+    )
+    assert tx in prep.txs
+
+    proc = client.process_proposal(
+        at.ProcessProposalRequest(txs=[tx], height=1)
+    )
+    assert proc.status == at.PROPOSAL_STATUS_ACCEPT
+
+    fin = client.finalize_block(
+        at.FinalizeBlockRequest(txs=[tx], height=1, hash=b"\x01" * 32)
+    )
+    assert len(fin.tx_results) == 1
+    assert fin.tx_results[0].code == at.CODE_TYPE_OK
+    assert fin.app_hash
+
+    client.commit()
+
+    q = client.query(at.QueryRequest(path="/key", data=b"grpckey"))
+    assert q.value == b"grpcval"
+
+    info = client.info(at.InfoRequest())
+    assert info.last_block_height == 1
+
+
+def test_snapshot_methods(grpc_app):
+    _, client = grpc_app
+    snaps = client.list_snapshots()
+    assert snaps.snapshots == []
+    offer = client.offer_snapshot(
+        at.OfferSnapshotRequest(
+            snapshot=at.Snapshot(height=5, format=1, chunks=2, hash=b"h"),
+            app_hash=b"a",
+        )
+    )
+    assert offer.result in (
+        at.OFFER_SNAPSHOT_ACCEPT,
+        at.OFFER_SNAPSHOT_REJECT,
+        at.OFFER_SNAPSHOT_REJECT_FORMAT,
+    )
+
+
+def test_vote_extensions(grpc_app):
+    _, client = grpc_app
+    client.init_chain(at.InitChainRequest(chain_id="ext-chain"))
+    ext = client.extend_vote(
+        at.ExtendVoteRequest(hash=b"\x02" * 32, height=1)
+    )
+    ver = client.verify_vote_extension(
+        at.VerifyVoteExtensionRequest(
+            hash=b"\x02" * 32,
+            validator_address=b"\x03" * 20,
+            height=1,
+            vote_extension=ext.vote_extension,
+        )
+    )
+    assert ver.status == at.VERIFY_VOTE_EXTENSION_ACCEPT

@@ -64,55 +64,112 @@ class TestMetrics:
 
 class TestGRPC:
     def test_version_block_and_pruning_services(self, ops_node):
-        from cometbft_tpu.rpc.grpc_server import grpc_call, make_client_channel
+        """Real protobuf round trips — the same messages an external data
+        companion built against the reference .proto files would send."""
+        import cometbft_tpu.proto_gen  # noqa: F401 — path hook
+
+        from cometbft.services.block.v1 import block_pb2 as block_svc_pb
+        from cometbft.services.block_results.v1 import (
+            block_results_pb2 as br_pb,
+        )
+        from cometbft.services.pruning.v1 import pruning_pb2 as pruning_pb
+        from cometbft.services.version.v1 import version_pb2 as version_pb
+
+        from cometbft_tpu.rpc.grpc_server import (
+            grpc_unary,
+            make_client_channel,
+        )
 
         node, _ = ops_node
         ch = make_client_channel(f"127.0.0.1:{node.grpc_server.bound_port}")
-        ver = grpc_call(
-            ch, "cometbft.services.version.v1.VersionService", "GetVersion", {}
+        ver = grpc_unary(
+            ch,
+            "cometbft.services.version.v1.VersionService",
+            "GetVersion",
+            version_pb.GetVersionRequest(),
+            version_pb.GetVersionResponse,
         )
-        assert ver["block"] == "11"
+        assert ver.block == 11 and ver.p2p == 9
 
-        blk = grpc_call(
+        blk = grpc_unary(
             ch,
             "cometbft.services.block.v1.BlockService",
             "GetByHeight",
-            {"height": "1"},
+            block_svc_pb.GetByHeightRequest(height=1),
+            block_svc_pb.GetByHeightResponse,
         )
-        assert blk["block"]["header"]["height"] == "1"
-        assert blk["block"]["header"]["chain_id"] == CHAIN_ID
+        assert blk.block.header.height == 1
+        assert blk.block.header.chain_id == CHAIN_ID
+        assert len(blk.block_id.hash) == 32
 
-        latest = grpc_call(
-            ch, "cometbft.services.block.v1.BlockService", "GetLatestHeight", {}
-        )
-        assert int(latest["height"]) >= 3
+        # streaming latest-height: first message is the current height
+        stream = ch.unary_stream(
+            "/cometbft.services.block.v1.BlockService/GetLatestHeight",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=(
+                block_svc_pb.GetLatestHeightResponse.FromString
+            ),
+        )(block_svc_pb.GetLatestHeightRequest())
+        first = next(iter(stream))
+        assert first.height >= 3
+        stream.cancel()
 
-        res = grpc_call(
+        res = grpc_unary(
             ch,
             "cometbft.services.block_results.v1.BlockResultsService",
             "GetBlockResults",
-            {"height": "1"},
+            br_pb.GetBlockResultsRequest(height=1),
+            br_pb.GetBlockResultsResponse,
         )
-        assert res["height"] == "1"
+        assert res.height == 1
 
         # privileged endpoint
         pch = make_client_channel(
             f"127.0.0.1:{node.grpc_privileged_server.bound_port}"
         )
         svc = "cometbft.services.pruning.v1.PruningService"
-        grpc_call(pch, svc, "SetBlockRetainHeight", {"height": "2"})
-        got = grpc_call(pch, svc, "GetBlockRetainHeight", {})
-        assert got["pruning_service_retain_height"] == "2"
+        grpc_unary(
+            pch,
+            svc,
+            "SetBlockRetainHeight",
+            pruning_pb.SetBlockRetainHeightRequest(height=2),
+            pruning_pb.SetBlockRetainHeightResponse,
+        )
+        got = grpc_unary(
+            pch,
+            svc,
+            "GetBlockRetainHeight",
+            pruning_pb.GetBlockRetainHeightRequest(),
+            pruning_pb.GetBlockRetainHeightResponse,
+        )
+        assert got.pruning_service_retain_height == 2
+
+        grpc_unary(
+            pch,
+            svc,
+            "SetTxIndexerRetainHeight",
+            pruning_pb.SetTxIndexerRetainHeightRequest(height=3),
+            pruning_pb.SetTxIndexerRetainHeightResponse,
+        )
+        ti = grpc_unary(
+            pch,
+            svc,
+            "GetTxIndexerRetainHeight",
+            pruning_pb.GetTxIndexerRetainHeightRequest(),
+            pruning_pb.GetTxIndexerRetainHeightResponse,
+        )
+        assert ti.height == 3
 
         # the version service must NOT exist on the privileged endpoint
         import grpc as _grpc
 
         with pytest.raises(_grpc.RpcError):
-            grpc_call(
+            grpc_unary(
                 pch,
                 "cometbft.services.version.v1.VersionService",
                 "GetVersion",
-                {},
+                version_pb.GetVersionRequest(),
+                version_pb.GetVersionResponse,
             )
 
 

@@ -1,0 +1,154 @@
+"""Background pruner service (reference: state/pruner.go): retain heights
+recorded by the executor / set by the data-companion gRPC API are acted on
+off the commit path — blocks, historical states, finalize-block responses
+(keeping the latest), and both indexers."""
+
+import json
+
+from cometbft_tpu.abci import types as at
+from cometbft_tpu.indexer.kv import KVBlockIndexer, KVTxIndexer
+from cometbft_tpu.state.execution import _PrunerHeights
+from cometbft_tpu.state.pruner import Pruner
+from cometbft_tpu.store.kv import MemKV
+
+
+class _FakeBlockStore:
+    def __init__(self, base=1, height=10):
+        self._base, self._height = base, height
+
+    def base(self):
+        return self._base
+
+    def height(self):
+        return self._height
+
+    def prune_blocks(self, retain):
+        n = max(0, retain - self._base)
+        self._base = max(self._base, retain)
+        return n
+
+
+class _FakeStateStore:
+    def __init__(self, heights):
+        self.responses = {h: b"{}" for h in heights}
+        self.pruned_states = []
+
+    def prune_states(self, frm, to, include_responses=True):
+        self.pruned_states.append((frm, to))
+        if include_responses:
+            for h in range(frm, to):
+                self.responses.pop(h, None)
+        return to - frm
+
+    def delete_finalize_block_response(self, h):
+        return self.responses.pop(h, None) is not None
+
+
+def _event(height):
+    return [
+        at.Event(
+            type_="tx",
+            attributes=[at.EventAttribute(key="n", value=str(height), index=True)],
+        )
+    ]
+
+
+def test_prune_once_all_kinds():
+    retain = _PrunerHeights(
+        app_retain=6,
+        companion_retain=4,
+        companion_results_retain=5,
+        tx_index_retain=3,
+        block_index_retain=3,
+    )
+    bs = _FakeBlockStore(base=1, height=10)
+    ss = _FakeStateStore(range(1, 11))
+    db = MemKV()
+    txi, bli = KVTxIndexer(db), KVBlockIndexer(db)
+    for h in range(1, 6):
+        txi.index(h, 0, b"tx%d" % h, at.ExecTxResult(events=_event(h)))
+        bli.index(h, _event(h))
+
+    p = Pruner(retain, bs, ss, tx_indexer=txi, block_indexer=bli,
+               interval_s=9999)
+    out = p.prune_once()
+
+    # blocks pruned to min(app=6, companion=4) = 4
+    assert bs.base() == 4 and out["blocks"] == 3
+    assert ss.pruned_states == [(1, 4)]
+    # results pruned below 5; 5..10 remain
+    assert sorted(ss.responses) == [5, 6, 7, 8, 9, 10]
+    assert out["results"] == 4
+    # indexers pruned below 3
+    assert out["tx_index"] == 2
+    assert txi.get(__import__("hashlib").sha256(b"tx1").digest()) is None
+    # heights 3..5 still searchable in block indexer
+    from cometbft_tpu.libs.pubsub import Query
+
+    assert bli.search(Query.parse("tx.n=4")) == [4]
+    assert bli.search(Query.parse("tx.n=2")) == []
+
+
+def test_app_retain_only():
+    retain = _PrunerHeights(app_retain=3)
+    bs = _FakeBlockStore(base=1, height=10)
+    ss = _FakeStateStore([])
+    p = Pruner(retain, bs, ss, interval_s=9999)
+    p.prune_once()
+    assert bs.base() == 3
+
+
+def test_retain_heights_persist_across_restart():
+    """A companion's hold on data must survive a node restart."""
+    from cometbft_tpu.state.store import StateStore
+
+    db = MemKV()
+    ss = StateStore(db)
+    retain = _PrunerHeights(companion_retain=50, tx_index_retain=7)
+    ss.save_retain_heights(retain)
+
+    restored = _PrunerHeights()
+    StateStore(db).load_retain_heights(restored)
+    assert restored.companion_retain == 50
+    assert restored.tx_index_retain == 7
+    assert restored.app_retain == 0  # app height comes from Commit, not disk
+
+
+def test_prune_survives_bad_retain_height():
+    """An absurd companion height must not wedge the other prune kinds."""
+    retain = _PrunerHeights(
+        companion_retain=10**9, companion_results_retain=5
+    )
+    bs = _FakeBlockStore(base=1, height=10)
+    ss = _FakeStateStore(range(1, 11))
+    p = Pruner(retain, bs, ss, interval_s=9999)
+    out = p.prune_once()
+    # clamped to height, not an exception; results still pruned
+    assert bs.base() == 10
+    assert out["results"] == 4
+
+
+def test_tx_primary_survives_reindex_above_retain():
+    """Same tx bytes committed at h=2 and h=50; retain=10 must keep the
+    (height-50) primary record."""
+    db = MemKV()
+    txi = KVTxIndexer(db)
+    txi.index(2, 0, b"dup-tx", at.ExecTxResult(events=_event(2)))
+    txi.index(50, 0, b"dup-tx", at.ExecTxResult(events=_event(50)))
+    import hashlib
+
+    h = hashlib.sha256(b"dup-tx").digest()
+    n = txi.prune(10)
+    assert n == 0  # primary kept: latest indexed height 50 >= 10
+    rec = txi.get(h)
+    assert rec is not None and rec.height == 50
+
+
+def test_results_keep_latest():
+    retain = _PrunerHeights(companion_results_retain=100)
+    bs = _FakeBlockStore(base=1, height=10)
+    ss = _FakeStateStore(range(1, 11))
+    p = Pruner(retain, bs, ss, interval_s=9999)
+    p.prune_once()
+    # capped at latest height: the height-10 response survives
+    assert sorted(ss.responses) == [10]
