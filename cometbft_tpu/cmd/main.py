@@ -336,6 +336,173 @@ def cmd_compact_db(args) -> int:
     return 0
 
 
+def _debug_collect(cfg, home: str, out_dir: str) -> list[str]:
+    """Collect debug artifacts from a running node into ``out_dir``.
+
+    Reference: cmd/cometbft/commands/debug/{kill,dump,util}.go — status,
+    net_info, dump_consensus_state, the config file, plus the pprof
+    goroutine/heap dumps when the profiling server is enabled.
+    """
+    import shutil
+    import urllib.request
+
+    collected = []
+
+    def fetch(base: str, route: str, fname: str):
+        try:
+            with urllib.request.urlopen(f"{base}/{route}", timeout=5) as resp:
+                data = resp.read()
+            with open(os.path.join(out_dir, fname), "wb") as f:
+                f.write(data)
+            collected.append(fname)
+        except Exception as e:  # noqa: BLE001
+            print(f"warning: could not fetch {route}: {e}")
+
+    rpc = cfg.rpc.laddr.replace("tcp://", "http://")
+    fetch(rpc, "status", "status.json")
+    fetch(rpc, "net_info", "net_info.json")
+    fetch(rpc, "dump_consensus_state", "consensus_state.json")
+
+    cfg_path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(cfg_path):
+        shutil.copy(cfg_path, os.path.join(out_dir, "config.toml"))
+        collected.append("config.toml")
+
+    # pprof artifacts when the profiling server is up
+    if cfg.rpc.pprof_laddr:
+        pprof = cfg.rpc.pprof_laddr.replace("tcp://", "http://")
+        fetch(pprof, "debug/pprof/goroutine", "goroutine.txt")
+        fetch(pprof, "debug/pprof/heap", "heap.txt")
+    return collected
+
+
+def cmd_debug_kill(args) -> int:
+    """Reference: commands/debug/kill.go — collect debug artifacts into a
+    zip, then SIGKILL the node process."""
+    import signal
+    import tempfile
+    import zipfile
+
+    cfg = _load_config(args.home)
+    with tempfile.TemporaryDirectory() as tmp:
+        files = _debug_collect(cfg, args.home, tmp)
+        with zipfile.ZipFile(args.output, "w", zipfile.ZIP_DEFLATED) as z:
+            for fname in files:
+                z.write(os.path.join(tmp, fname), fname)
+    print(f"wrote {len(files)} artifacts to {args.output}")
+    try:
+        os.kill(args.pid, signal.SIGKILL)
+        print(f"killed process {args.pid}")
+    except ProcessLookupError:
+        print(f"no such process: {args.pid}")
+        return 1
+    return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """Reference: commands/debug/dump.go — periodically collect debug
+    artifacts into timestamped zips under the output directory."""
+    import time as _time
+    import zipfile
+
+    cfg = _load_config(args.home)
+    os.makedirs(args.output_dir, exist_ok=True)
+    iterations = args.iterations
+    while True:
+        stamp = _time.strftime("%Y%m%d%H%M%S")
+        tmp = os.path.join(args.output_dir, f".collect-{stamp}")
+        os.makedirs(tmp, exist_ok=True)
+        files = _debug_collect(cfg, args.home, tmp)
+        out = os.path.join(args.output_dir, f"{stamp}.zip")
+        with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+            for fname in files:
+                z.write(os.path.join(tmp, fname), fname)
+        for fname in files:
+            os.unlink(os.path.join(tmp, fname))
+        os.rmdir(tmp)
+        print(f"wrote {out} ({len(files)} artifacts)")
+        if iterations is not None:
+            iterations -= 1
+            if iterations <= 0:
+                return 0
+        _time.sleep(args.frequency)
+
+
+def cmd_reindex_event(args) -> int:
+    """Reference: commands/reindex_event.go — replay stored blocks and
+    finalize-block responses through the configured indexer sinks.
+
+    The node must NOT be running (the stores are opened directly)."""
+    from cometbft_tpu.state.execution import fbr_from_json
+    from cometbft_tpu.state.store import StateStore
+    from cometbft_tpu.store.block_store import BlockStore
+    from cometbft_tpu.store.kv import SqliteKV
+
+    cfg = _load_config(args.home)
+    db_path = os.path.join(cfg.base.home, cfg.base.db_dir, "chain.db")
+    if not os.path.exists(db_path):
+        print(f"no database at {db_path}")
+        return 1
+    db = SqliteKV(db_path)
+    try:
+        block_store = BlockStore(db)
+        state_store = StateStore(db)
+
+        base, height = block_store.base(), block_store.height()
+        if height == 0:
+            print("no blocks stored; nothing to reindex")
+            return 1
+        start = args.start_height or max(base, 1)
+        end = args.end_height or height
+        if start < base or end > height or start > end:
+            print(
+                f"height range [{start}, {end}] outside stored "
+                f"[{base}, {height}]"
+            )
+            return 1
+
+        if cfg.tx_index.indexer == "kv":
+            from cometbft_tpu.indexer import KVBlockIndexer, KVTxIndexer
+
+            tx_indexer, block_indexer = KVTxIndexer(db), KVBlockIndexer(db)
+        elif cfg.tx_index.indexer == "psql":
+            from cometbft_tpu.indexer.psql import (
+                PsqlBlockIndexerAdapter,
+                PsqlEventSink,
+                PsqlTxIndexerAdapter,
+            )
+            from cometbft_tpu.types.genesis import GenesisDoc
+
+            gpath = os.path.join(cfg.base.home, cfg.base.genesis_file)
+            with open(gpath) as f:
+                chain_id = GenesisDoc.from_json(f.read()).chain_id
+            sink = PsqlEventSink(cfg.tx_index.psql_conn, chain_id)
+            tx_indexer = PsqlTxIndexerAdapter(sink)
+            block_indexer = PsqlBlockIndexerAdapter(sink)
+        else:
+            print("reindex requires a non-null indexer")
+            return 1
+
+        n_blocks = n_txs = 0
+        for h in range(start, end + 1):
+            block = block_store.load_block(h)
+            raw = state_store.load_finalize_block_response(h)
+            if block is None or raw is None:
+                print(f"warning: missing block or results at height {h}")
+                continue
+            res = fbr_from_json(raw)
+            block_indexer.index(h, res.events)
+            for i, tx in enumerate(block.data.txs):
+                if i < len(res.tx_results):
+                    tx_indexer.index(h, i, tx, res.tx_results[i])
+                    n_txs += 1
+            n_blocks += 1
+        print(f"reindexed {n_blocks} blocks, {n_txs} txs in [{start}, {end}]")
+        return 0
+    finally:
+        db.close()
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cometbft_tpu", description="TPU-native BFT consensus node"
@@ -398,6 +565,38 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("compact-db", help="compact the embedded database")
     sp.set_defaults(fn=cmd_compact_db)
+
+    # debug kill/dump (reference: commands/debug/debug.go)
+    sp = sub.add_parser("debug", help="debug utilities for a running node")
+    dsub = sp.add_subparsers(dest="debug_command", required=True)
+    dk = dsub.add_parser(
+        "kill", help="collect debug artifacts into a zip, then kill the node"
+    )
+    dk.add_argument("pid", type=int, help="node process id")
+    dk.add_argument("output", help="output zip path")
+    dk.set_defaults(fn=cmd_debug_kill)
+    dd = dsub.add_parser(
+        "dump", help="periodically collect debug artifacts into a directory"
+    )
+    dd.add_argument("output_dir", help="directory for timestamped zips")
+    dd.add_argument(
+        "--frequency", type=float, default=30.0, help="seconds between dumps"
+    )
+    dd.add_argument(
+        "--iterations",
+        type=int,
+        default=None,
+        help="stop after N dumps (default: run until interrupted)",
+    )
+    dd.set_defaults(fn=cmd_debug_dump)
+
+    sp = sub.add_parser(
+        "reindex-event",
+        help="re-run the indexers over stored blocks (node must be stopped)",
+    )
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

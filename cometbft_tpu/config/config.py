@@ -252,12 +252,14 @@ class StorageConfig:
 class TxIndexConfig:
     """Reference: config/config.go TxIndexConfig."""
 
-    indexer: str = "kv"  # kv | null
+    indexer: str = "kv"  # kv | null | psql
     psql_conn: str = ""
 
     def validate_basic(self) -> Optional[str]:
         if self.indexer not in ("kv", "null", "psql"):
             return "unknown indexer"
+        if self.indexer == "psql" and not self.psql_conn:
+            return "the psql connection settings cannot be empty"
         return None
 
 

