@@ -409,7 +409,12 @@ def cmd_debug_dump(args) -> int:
     os.makedirs(args.output_dir, exist_ok=True)
     iterations = args.iterations
     while True:
-        stamp = _time.strftime("%Y%m%d%H%M%S")
+        # millisecond resolution: sub-second --frequency must not
+        # overwrite the previous iteration's archive
+        stamp = "%s%03d" % (
+            _time.strftime("%Y%m%d%H%M%S"),
+            int(_time.time() * 1000) % 1000,
+        )
         tmp = os.path.join(args.output_dir, f".collect-{stamp}")
         os.makedirs(tmp, exist_ok=True)
         files = _debug_collect(cfg, args.home, tmp)

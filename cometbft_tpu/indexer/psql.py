@@ -143,8 +143,12 @@ class PsqlEventSink:
             self._dialect = "pg"
             with self._conn, self._conn.cursor() as cur:
                 cur.execute(_SCHEMA_PG)
-                # psql CREATE VIEW IF NOT EXISTS arrived in pg 9.3+ as OR REPLACE
-                cur.execute(_VIEWS.replace("IF NOT EXISTS", "OR REPLACE"))
+                # postgres has no CREATE VIEW IF NOT EXISTS; use OR REPLACE
+                cur.execute(
+                    _VIEWS.replace(
+                        "CREATE VIEW IF NOT EXISTS", "CREATE OR REPLACE VIEW"
+                    )
+                )
 
     # -- SQL helpers --------------------------------------------------------
 
@@ -297,18 +301,20 @@ class PsqlEventSink:
     # -- serving searches (beyond the reference's sink) ---------------------
 
     def has_block(self, height: int) -> bool:
-        cur = self._exec(
-            "SELECT 1 FROM blocks WHERE height = ? AND chain_id = ?",
-            (height, self.chain_id),
-        )
-        return cur.fetchone() is not None
+        with self._lock:
+            cur = self._exec(
+                "SELECT 1 FROM blocks WHERE height = ? AND chain_id = ?",
+                (height, self.chain_id),
+            )
+            return cur.fetchone() is not None
 
     def get_tx_by_hash(self, hash_: bytes) -> Optional[TxResult]:
-        cur = self._exec(
-            "SELECT tx_result FROM tx_results WHERE tx_hash = ?",
-            (hash_.hex().upper(),),
-        )
-        row = cur.fetchone()
+        with self._lock:
+            cur = self._exec(
+                "SELECT tx_result FROM tx_results WHERE tx_hash = ?",
+                (hash_.hex().upper(),),
+            )
+            row = cur.fetchone()
         return self._decode_tx_result(row[0]) if row else None
 
     @staticmethod
@@ -355,7 +361,18 @@ class PsqlEventSink:
         if op == "EXISTS":
             return base, params
         if op == "CONTAINS":
-            return base + " AND value LIKE ?", params + [f"%{operand}%"]
+            # literal-substring semantics (kv indexer parity): escape LIKE
+            # wildcards in the operand
+            esc = (
+                str(operand)
+                .replace("\\", "\\\\")
+                .replace("%", "\\%")
+                .replace("_", "\\_")
+            )
+            return (
+                base + " AND value LIKE ? ESCAPE '\\'",
+                params + [f"%{esc}%"],
+            )
         if isinstance(operand, (int, float)):
             cast = (
                 "CAST(value AS NUMERIC)"
@@ -415,6 +432,17 @@ class PsqlTxIndexerAdapter:
     def index(self, height, index, tx, result) -> None:
         self.sink.index_tx_events(
             [TxResult(height=height, index=index, tx=tx, result=result)]
+        )
+
+    def index_batch(self, batch) -> None:
+        """One sink call (one block SELECT + one commit) for a whole
+        drain of tx events — the shape IndexTxEvents is built for."""
+        self.sink.index_tx_events(
+            [
+                TxResult(height=d.height, index=d.index, tx=d.tx,
+                         result=d.result)
+                for d in batch
+            ]
         )
 
     def get(self, hash_: bytes):

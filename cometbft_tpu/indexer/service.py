@@ -68,15 +68,28 @@ class IndexerService(BaseService):
                     self.block_indexer.index(data.height, data.events)
                 except Exception as e:  # noqa: BLE001
                     self.logger.error("block index failed", err=repr(e))
+            txs = []
             while True:
                 tx_msg = self._tx_sub.next(timeout=0)
                 if tx_msg is None:
                     break
                 drained += 1
-                d: tev.EventDataTx = tx_msg.data
+                txs.append(tx_msg.data)
+            if txs:
                 try:
-                    self.tx_indexer.index(d.height, d.index, d.tx, d.result)
+                    self._index_txs(txs)
                 except Exception as e:  # noqa: BLE001
                     self.logger.error("tx index failed", err=repr(e))
             if not drained:
                 time.sleep(0.02)
+
+    def _index_txs(self, batch) -> None:
+        """One drain's worth of txs: use the indexer's batch entry point
+        when it has one (the psql sink commits once per batch, reference
+        psql.go IndexTxEvents takes the whole block's txs) else per-tx."""
+        index_batch = getattr(self.tx_indexer, "index_batch", None)
+        if index_batch is not None:
+            index_batch(batch)
+            return
+        for d in batch:
+            self.tx_indexer.index(d.height, d.index, d.tx, d.result)

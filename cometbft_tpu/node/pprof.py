@@ -5,8 +5,8 @@ config key is set.  The Python-runtime equivalents exposed here, same
 path layout (``/debug/pprof/...``):
 
   * ``/debug/pprof/``          — index of available profiles
-  * ``/debug/pprof/profile``   — CPU profile via cProfile for
-    ``?seconds=N`` (default 5), returned as pstats text
+  * ``/debug/pprof/profile``   — sampling CPU profile over all threads
+    for ``?seconds=N`` (default 5), self/cumulative hit counts
   * ``/debug/pprof/heap``      — tracemalloc snapshot (top allocations);
     starts tracemalloc on first use
   * ``/debug/pprof/goroutine`` — stack dump of every live thread (the
@@ -17,9 +17,9 @@ path layout (``/debug/pprof/...``):
 
 from __future__ import annotations
 
-import cProfile
+
 import io
-import pstats
+
 import sys
 import threading
 import time
@@ -59,14 +59,44 @@ def heap_snapshot(top: int = 50) -> str:
     return "\n".join(out) + "\n"
 
 
-def cpu_profile(seconds: float) -> str:
-    prof = cProfile.Profile()
-    prof.enable()
-    time.sleep(seconds)
-    prof.disable()
-    buf = io.StringIO()
-    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(60)
-    return buf.getvalue()
+def cpu_profile(seconds: float, hz: int = 100) -> str:
+    """Statistical CPU profile across ALL threads.
+
+    cProfile instruments only the calling thread — which here would be
+    the HTTP handler asleep in time.sleep, observing nothing.  Instead,
+    sample every live thread's stack via ``sys._current_frames()`` at
+    ``hz`` and aggregate self/cumulative hit counts — the shape of Go's
+    sampling pprof, which profiles all goroutines."""
+    self_hits: dict = {}
+    cum_hits: dict = {}
+    me = threading.get_ident()
+    nticks = 0
+    deadline = time.monotonic() + seconds
+    interval = 1.0 / hz
+    while time.monotonic() < deadline:
+        nticks += 1
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            f = frame
+            leaf = f"{f.f_code.co_filename}:{f.f_lineno} {f.f_code.co_name}"
+            self_hits[leaf] = self_hits.get(leaf, 0) + 1
+            seen = set()
+            while f is not None:
+                key = f"{f.f_code.co_filename} {f.f_code.co_name}"
+                if key not in seen:
+                    seen.add(key)
+                    cum_hits[key] = cum_hits.get(key, 0) + 1
+                f = f.f_back
+        time.sleep(interval)
+    out = [f"samples: {nticks} ticks @ {hz} Hz over {seconds}s, all threads"]
+    out.append("\ntop 40 by self samples (thread was exactly here):")
+    for k, v in sorted(self_hits.items(), key=lambda kv: -kv[1])[:40]:
+        out.append(f"  {v:6d} {k}")
+    out.append("\ntop 40 by cumulative samples (frame anywhere on stack):")
+    for k, v in sorted(cum_hits.items(), key=lambda kv: -kv[1])[:40]:
+        out.append(f"  {v:6d} {k}")
+    return "\n".join(out) + "\n"
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -145,23 +145,28 @@ def zero_like(a: F) -> F:
 # Carry machinery: static interval analysis drives the emitted step count.
 # ---------------------------------------------------------------------------
 
-def _carry_interval_step(lo: int, hi: int) -> tuple[int, int]:
-    """One parallel carry round in interval arithmetic (all limbs pooled,
-    including limb 0's x608 fold — pessimistic but sound)."""
-    c_lo = (lo + HALF) >> BITS
-    c_hi = (hi + HALF) >> BITS
-    in_lo = min(c_lo, FOLD * c_lo, 0)
-    in_hi = max(c_hi, FOLD * c_hi, 0)
-    return -HALF + in_lo, HALF - 1 + in_hi
+def _sim_carry_rounds(bounds: list) -> tuple[int, list]:
+    """Exact per-limb interval simulation of repeated ``_carry_once``.
 
-
-def _steps_to_reduce(lo: int, hi: int) -> int:
-    steps = 0
-    while lo < RED_LO or hi > RED_HI:
-        lo, hi = _carry_interval_step(lo, hi)
-        steps += 1
-        assert steps <= 8, "carry interval analysis diverged"
-    return steps
+    ``bounds``: 20 (lo, hi) pairs.  Returns (#rounds, final per-limb
+    bounds), stopping when every limb is inside the RED hull.  Tracking
+    limbs individually matters: only limb 0 receives the x608 wrap fold,
+    so the big post-multiply bound rotates upward one limb per round and
+    shrinks by >>13 — the old pooled analysis charged the fold to *every*
+    limb and emitted ~2 extra rounds per mul."""
+    rounds = 0
+    while min(l for l, _ in bounds) < RED_LO or max(h for _, h in bounds) > RED_HI:
+        c = [((l + HALF) >> BITS, (h + HALF) >> BITS) for l, h in bounds]
+        bounds = [
+            (
+                -HALF + (FOLD * c[-1][0] if i == 0 else c[i - 1][0]),
+                HALF - 1 + (FOLD * c[-1][1] if i == 0 else c[i - 1][1]),
+            )
+            for i in range(NLIMBS)
+        ]
+        rounds += 1
+        assert rounds <= 8, "carry interval analysis diverged"
+    return rounds, bounds
 
 
 def _carry_once(v: jnp.ndarray) -> jnp.ndarray:
@@ -176,11 +181,11 @@ def _carry_once(v: jnp.ndarray) -> jnp.ndarray:
 def carry(a: F) -> F:
     """Reduce to the RED fixpoint; emits exactly as many parallel rounds as
     the static bounds require (0 if already reduced)."""
-    lo, hi, v = a.lo, a.hi, a.v
-    for _ in range(_steps_to_reduce(lo, hi)):
+    rounds, bounds = _sim_carry_rounds([(a.lo, a.hi)] * NLIMBS)
+    v = a.v
+    for _ in range(rounds):
         v = _carry_once(v)
-        lo, hi = _carry_interval_step(lo, hi)
-    return F(v, max(lo, RED_LO), min(hi, RED_HI))
+    return F(v, min(l for l, _ in bounds), max(h for _, h in bounds))
 
 
 def red(a: F) -> F:
@@ -218,12 +223,18 @@ def mul_small(a: F, k: int) -> F:
     return F(a.v * k, lo, hi)
 
 
-def _reduce_cols(x: jnp.ndarray, colbound: int) -> F:
-    """(40, B) product columns (39 + zero pad, static bound) -> reduced F.
+def _reduce_cols(x: jnp.ndarray, prodmax: int) -> F:
+    """(40, B) product columns (39 + zero pad) -> reduced F.  ``prodmax``
+    is a static bound on one limb product |a_i * b_j|.
 
     Stage A: parallel-carry the column array as a plain 40-limb number
     (no fold) until limbs are small; stage B: fold the high 20 limbs into
     the low 20 with weight 2^260 ≡ 608; stage C: carry to RED.
+
+    All three stages run on exact per-column interval vectors: column k of
+    a 20x20 schoolbook product has min(k+1, 39-k) terms, so the edge
+    columns start ~20x smaller than the center — which is precisely what
+    keeps the stage-B fold bound (and hence the stage-C round count) low.
 
     Limb 39 (the zero pad) receives carries from limb 38 but never emits
     one — a carry out of limb 39 has weight 2^520 and there is nowhere
@@ -232,15 +243,25 @@ def _reduce_cols(x: jnp.ndarray, colbound: int) -> F:
     (Round-2 bug: the carry out of limb 39 was silently dropped, losing
     c39*2^520 whenever |cols[38]| >= 2^25 — data-dependent corruption.)
     """
-    lo, hi = -colbound, colbound  # signed limbs -> signed product columns
-    top_lo, top_hi = 0, 0  # limb 39 starts at the zero pad, accumulates
-    # stage A (fold-free carry: same interval step with FOLD→1)
+    b = [
+        (-min(k + 1, 39 - k) * prodmax, min(k + 1, 39 - k) * prodmax)
+        for k in range(39)
+    ] + [(0, 0)]
+    # stage A (fold-free carry; limb 39 accumulates, never emits)
     steps = 0
-    while lo < -HALF - 1 or hi > HALF + 1:
-        c_lo, c_hi = (lo + HALF) >> BITS, (hi + HALF) >> BITS
-        top_lo += min(c_lo, 0)
-        top_hi += max(c_hi, 0)
-        lo, hi = -HALF + min(c_lo, 0), HALF - 1 + max(c_hi, 0)
+    while (
+        min(l for l, _ in b[:-1]) < -HALF - 1
+        or max(h for _, h in b[:-1]) > HALF + 1
+    ):
+        c = [((l + HALF) >> BITS, (h + HALF) >> BITS) for l, h in b[:-1]]
+        b = (
+            [(-HALF, HALF - 1)]
+            + [
+                (-HALF + c[i - 1][0], HALF - 1 + c[i - 1][1])
+                for i in range(1, 39)
+            ]
+            + [(b[39][0] + c[38][0], b[39][1] + c[38][1])]
+        )
         steps += 1
         assert steps <= 6
     for _ in range(steps):
@@ -252,10 +273,17 @@ def _reduce_cols(x: jnp.ndarray, colbound: int) -> F:
     # stage B: value = lo20 + 2^260 * hi20
     lo20, hi20 = x[:NLIMBS], x[NLIMBS:]
     v = lo20 + FOLD * hi20
-    blo = lo + FOLD * min(lo, top_lo)
-    bhi = hi + FOLD * max(hi, top_hi)
-    assert -(2**31) < blo and bhi < 2**31, "stage-B fold overflow"
-    return carry(F(v, blo, bhi))
+    vb = []
+    for i in range(NLIMBS):
+        l = b[i][0] + FOLD * b[NLIMBS + i][0]
+        h = b[i][1] + FOLD * b[NLIMBS + i][1]
+        assert -(2**31) < l and h < 2**31, "stage-B fold overflow"
+        vb.append((l, h))
+    # stage C: carry to RED, per-limb
+    rounds, vb = _sim_carry_rounds(vb)
+    for _ in range(rounds):
+        v = _carry_once(v)
+    return F(v, min(l for l, _ in vb), max(h for _, h in vb))
 
 
 def _cols_skew(a: F, b: F) -> jnp.ndarray:
@@ -293,17 +321,46 @@ def _cols_rows(a: F, b: F) -> jnp.ndarray:
     return acc
 
 
+def _cols_sq(a: F) -> jnp.ndarray:
+    """(40, B) product columns of a^2 via the symmetric triangle: row j
+    contributes a_j * (a_j, 2a_{j+1}, ..., 2a_{19}) at columns 2j..j+19 —
+    210 limb products instead of the full 400 (the off-diagonal terms each
+    appear once, pre-doubled).  Shifted-row placement only (static-shape
+    concatenates), so the same form lowers under Mosaic and XLA."""
+    n = NLIMBS
+    B = a.v.shape[1]
+    a2 = a.v * 2
+    acc = None
+    for j in range(n):
+        head = a.v[j : j + 1] * a.v[j][None, :]
+        if j + 1 < n:
+            prod = jnp.concatenate([head, a2[j + 1 :] * a.v[j][None, :]])
+        else:
+            prod = head
+        parts = [] if j == 0 else [jnp.zeros((2 * j, B), a.v.dtype)]
+        parts += [prod, jnp.zeros((n - j, B), a.v.dtype)]
+        padded = jnp.concatenate(parts, axis=0)  # (2n, B)
+        acc = padded if acc is None else acc + padded
+    return acc
+
+
 def mul(a: F, b: F) -> F:
     """Schoolbook 20x20 product, fully on the VPU (no dot_general)."""
+    if a is b:
+        return square(a)
     # auto-reduce operands until the 20-term column bound fits int32
     while NLIMBS * a.absmax * b.absmax >= _I32_LIMIT:
         a, b = (carry(a), b) if a.absmax >= b.absmax else (a, carry(b))
     cols = (_cols_rows if _KERNEL_MODE[-1] else _cols_skew)(a, b)
-    return _reduce_cols(cols, NLIMBS * a.absmax * b.absmax)
+    return _reduce_cols(cols, a.absmax * b.absmax)
 
 
 def square(a: F) -> F:
-    return mul(a, a)
+    """a^2 via the half-triangle column form (~half the limb products of
+    ``mul``; column values and bounds are identical)."""
+    while NLIMBS * a.absmax * a.absmax >= _I32_LIMIT:
+        a = carry(a)
+    return _reduce_cols(_cols_sq(a), a.absmax * a.absmax)
 
 
 # ---------------------------------------------------------------------------
