@@ -609,6 +609,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    # Pin the JAX platform before any lazy jax use.  This must happen at
+    # the config level: some environments (axon) install a sitecustomize
+    # that force-sets jax_platforms at interpreter start, overriding the
+    # JAX_PLATFORMS env var — e.g. multi-node testnets on one host must
+    # run the crypto backend on CPU, not fight over one TPU chip.
+    plat = os.environ.get("COMETBFT_TPU_JAX_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     parser = build_parser()
     args = parser.parse_args(argv)
     if not getattr(args, "fn", None):

@@ -171,74 +171,87 @@ class PsqlEventSink:
     def index_block_events(self, height: int, events) -> None:
         ts = datetime.now(timezone.utc).isoformat()
         with self._lock:
-            cur = self._exec(
-                'SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?',
-                (height, self.chain_id),
-            )
-            if cur.fetchone() is not None:
-                return  # already indexed; quietly succeed (reference :204)
-            block_id = _random_bigserial()
-            self._exec(
-                "INSERT INTO blocks (rowid, height, chain_id, created_at)"
-                " VALUES (?, ?, ?, ?)",
-                (block_id, height, self.chain_id, ts),
-            )
-            self._insert_events(
-                block_id,
-                None,
-                self._with_meta_events(
-                    [(BLOCK_HEIGHT_KEY, str(height))], events
-                ),
-            )
-            self._commit()
+            try:
+                cur = self._exec(
+                    'SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?',
+                    (height, self.chain_id),
+                )
+                if cur.fetchone() is not None:
+                    return  # already indexed; quietly succeed (reference :204)
+                block_id = _random_bigserial()
+                self._exec(
+                    "INSERT INTO blocks (rowid, height, chain_id, created_at)"
+                    " VALUES (?, ?, ?, ?)",
+                    (block_id, height, self.chain_id, ts),
+                )
+                self._insert_events(
+                    block_id,
+                    None,
+                    self._with_meta_events(
+                        [(BLOCK_HEIGHT_KEY, str(height))], events
+                    ),
+                )
+                self._commit()
+            except Exception:
+                self._conn.rollback()
+                raise
 
     def index_tx_events(self, txrs: Sequence[TxResult]) -> None:
         ts = datetime.now(timezone.utc).isoformat()
         with self._lock:
-            for txr in txrs:
-                cur = self._exec(
-                    "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
-                    (txr.height, self.chain_id),
+            try:
+                self._index_tx_events_locked(txrs, ts)
+            except Exception:
+                # never leave partial inserts in the open transaction for
+                # a later unrelated commit to pick up
+                self._conn.rollback()
+                raise
+
+    def _index_tx_events_locked(self, txrs: Sequence[TxResult], ts) -> None:
+        for txr in txrs:
+            cur = self._exec(
+                "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
+                (txr.height, self.chain_id),
+            )
+            row = cur.fetchone()
+            if row is None:
+                raise LookupError(
+                    f"block {txr.height} not indexed before its txs"
                 )
-                row = cur.fetchone()
-                if row is None:
-                    raise LookupError(
-                        f"block {txr.height} not indexed before its txs"
-                    )
-                block_id = row[0]
-                cur = self._exec(
-                    'SELECT 1 FROM tx_results WHERE block_id = ? AND "index" = ?',
-                    (block_id, txr.index),
-                )
-                if cur.fetchone() is not None:
-                    continue  # already indexed
-                tx_hash = txr.hash.hex().upper()
-                tx_id = _random_bigserial()
-                self._exec(
-                    "INSERT INTO tx_results "
-                    '(rowid, block_id, "index", created_at, tx_hash, tx_result)'
-                    " VALUES (?, ?, ?, ?, ?, ?)",
-                    (
-                        tx_id,
-                        block_id,
-                        txr.index,
-                        ts,
-                        tx_hash,
-                        self._wire_tx_result(txr),
-                    ),
-                )
-                self._insert_events(
-                    block_id,
+            block_id = row[0]
+            cur = self._exec(
+                'SELECT 1 FROM tx_results WHERE block_id = ? AND "index" = ?',
+                (block_id, txr.index),
+            )
+            if cur.fetchone() is not None:
+                continue  # already indexed
+            tx_hash = txr.hash.hex().upper()
+            tx_id = _random_bigserial()
+            self._exec(
+                "INSERT INTO tx_results "
+                '(rowid, block_id, "index", created_at, tx_hash, tx_result)'
+                " VALUES (?, ?, ?, ?, ?, ?)",
+                (
                     tx_id,
-                    self._with_meta_events(
-                        [
-                            (TX_HASH_KEY, tx_hash),
-                            (TX_HEIGHT_KEY, str(txr.height)),
-                        ],
-                        txr.result.events,
-                    ),
-                )
-            self._commit()
+                    block_id,
+                    txr.index,
+                    ts,
+                    tx_hash,
+                    self._wire_tx_result(txr),
+                ),
+            )
+            self._insert_events(
+                block_id,
+                tx_id,
+                self._with_meta_events(
+                    [
+                        (TX_HASH_KEY, tx_hash),
+                        (TX_HEIGHT_KEY, str(txr.height)),
+                    ],
+                    txr.result.events,
+                ),
+            )
+        self._commit()
 
     @staticmethod
     def _wire_tx_result(txr: TxResult) -> bytes:

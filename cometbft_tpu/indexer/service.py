@@ -76,20 +76,27 @@ class IndexerService(BaseService):
                 drained += 1
                 txs.append(tx_msg.data)
             if txs:
-                try:
-                    self._index_txs(txs)
-                except Exception as e:  # noqa: BLE001
-                    self.logger.error("tx index failed", err=repr(e))
+                self._index_txs(txs)
             if not drained:
                 time.sleep(0.02)
 
     def _index_txs(self, batch) -> None:
         """One drain's worth of txs: use the indexer's batch entry point
         when it has one (the psql sink commits once per batch, reference
-        psql.go IndexTxEvents takes the whole block's txs) else per-tx."""
+        psql.go IndexTxEvents takes the whole block's txs) else per-tx.
+        A failing batch falls back to per-tx indexing so one bad tx never
+        discards the rest of the drain, and per-tx errors are isolated."""
         index_batch = getattr(self.tx_indexer, "index_batch", None)
         if index_batch is not None:
-            index_batch(batch)
-            return
+            try:
+                index_batch(batch)
+                return
+            except Exception as e:  # noqa: BLE001
+                self.logger.error(
+                    "batch tx index failed; retrying per-tx", err=repr(e)
+                )
         for d in batch:
-            self.tx_indexer.index(d.height, d.index, d.tx, d.result)
+            try:
+                self.tx_indexer.index(d.height, d.index, d.tx, d.result)
+            except Exception as e:  # noqa: BLE001
+                self.logger.error("tx index failed", err=repr(e))

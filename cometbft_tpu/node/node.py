@@ -54,8 +54,17 @@ def _builtin_app(name: str):
 class Node(BaseService):
     """Reference: node/node.go Node."""
 
-    def __init__(self, config: Config, logger: Optional[liblog.Logger] = None):
+    def __init__(
+        self,
+        config: Config,
+        logger: Optional[liblog.Logger] = None,
+        app=None,
+    ):
+        """``app``: optional in-process ABCI application overriding
+        ``config.base.proxy_app`` (the reference's custom-client-creator
+        injection, node/setup.go DefaultNewNode vs NewNodeWithCliParams)."""
         super().__init__("Node")
+        self._app_override = app
         self.config = config
         self.logger = logger or liblog.Logger(
             level=liblog.parse_level(config.base.log_level)
@@ -109,7 +118,10 @@ class Node(BaseService):
             )
 
         # -- ABCI proxy (reference: node/node.go:359) -----------------------
-        if config.base.abci == "grpc":
+        if self._app_override is not None:
+            self.app = self._app_override
+            creator = local_client_creator(self.app)
+        elif config.base.abci == "grpc":
             self.app = None
             creator = remote_client_creator(
                 config.base.proxy_app, transport="grpc"

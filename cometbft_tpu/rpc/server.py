@@ -143,7 +143,10 @@ class RPCServer:
                 body = self.rfile.read(length)
                 try:
                     req = json.loads(body)
-                except json.JSONDecodeError:
+                except (json.JSONDecodeError, UnicodeDecodeError, ValueError,
+                        RecursionError):
+                    # non-UTF8 bytes, malformed JSON and parser bombs all
+                    # get the spec parse-error reply, never a broken conn
                     self._send_json(
                         _rpc_response(
                             None, error={"code": -32700, "message": "parse error"}
@@ -178,9 +181,21 @@ class RPCServer:
     # -- route dispatch ----------------------------------------------------
 
     def _call_route_json(self, req: dict) -> bytes:
+        if not isinstance(req, dict):
+            return _rpc_response(
+                None, error={"code": -32600, "message": "invalid request"}
+            )
         id_ = req.get("id", -1)
         method = req.get("method", "")
+        if not isinstance(method, str):
+            return _rpc_response(
+                id_, error={"code": -32600, "message": "method must be a string"}
+            )
         params = req.get("params") or {}
+        if not isinstance(params, (dict, list)):
+            return _rpc_response(
+                id_, error={"code": -32602, "message": "params must be an object"}
+            )
         if isinstance(params, list):
             return _rpc_response(
                 id_,

@@ -1,0 +1,8 @@
+"""Process-isolated end-to-end test harness.
+
+Mirrors the reference's ``test/e2e`` suite (manifests, runner,
+perturbations, load, invariant tests, benchmark) with OS processes on
+localhost standing in for the reference's docker-compose containers:
+the isolation that matters — separate interpreters, real TCP p2p/RPC,
+kill -9 crash recovery — is the same.
+"""

@@ -1,0 +1,124 @@
+"""ABCI call-sequence grammar checker.
+
+Validates that the sequence of consensus/snapshot-connection ABCI calls a
+node makes conforms to the spec grammar — the same contract the
+reference's e2e grammar checker enforces (test/e2e/pkg/grammar, ABCI 2.x
+spec):
+
+    start          = clean-start | recovery
+    clean-start    = init_chain state-sync? consensus-exec
+    state-sync     = offer_snapshot apply_snapshot_chunk+
+    recovery       = consensus-exec
+    consensus-exec = consensus-height+
+    consensus-height = entry* finalize_block commit
+    entry          = prepare_proposal | process_proposal
+                   | extend_vote | verify_vote_extension
+
+CheckTx (mempool connection) and Info/Query/Echo (query connection) run
+concurrently on other connections and are outside the grammar, exactly as
+in the reference checker.
+"""
+
+from __future__ import annotations
+
+GRAMMAR_METHODS = frozenset(
+    {
+        "init_chain",
+        "offer_snapshot",
+        "apply_snapshot_chunk",
+        "prepare_proposal",
+        "process_proposal",
+        "extend_vote",
+        "verify_vote_extension",
+        "finalize_block",
+        "commit",
+    }
+)
+
+_ENTRY = {
+    "prepare_proposal",
+    "process_proposal",
+    "extend_vote",
+    "verify_vote_extension",
+}
+
+
+class GrammarError(Exception):
+    def __init__(self, pos: int, got: str, expected: str):
+        self.pos, self.got, self.expected = pos, got, expected
+        super().__init__(
+            f"ABCI grammar violation at call #{pos}: got {got!r}, "
+            f"expected {expected}"
+        )
+
+
+class Recorder:
+    """Records grammar-relevant ABCI calls; wrap an Application with
+    ``recording_app`` or call ``note`` from instrumentation."""
+
+    def __init__(self):
+        self.trace: list[str] = []
+
+    def note(self, method: str) -> None:
+        if method in GRAMMAR_METHODS:
+            self.trace.append(method)
+
+
+def recording_app(app, recorder: Recorder):
+    """Proxy that notes every grammar-relevant method before delegating."""
+
+    class _Proxy:
+        def __getattr__(self, name):
+            target = getattr(app, name)
+            if name in GRAMMAR_METHODS and callable(target):
+                def wrapper(*a, __t=target, __n=name, **kw):
+                    recorder.note(__n)
+                    return __t(*a, **kw)
+
+                return wrapper
+            return target
+
+    return _Proxy()
+
+
+def check(trace: list[str], clean_start: bool | None = None) -> int:
+    """Validate a trace; returns the number of consensus heights seen.
+
+    clean_start: True requires init_chain first; False forbids it; None
+    accepts either (recovery vs clean start inferred from the trace).
+    """
+    i, n = 0, len(trace)
+
+    def peek():
+        return trace[i] if i < n else None
+
+    if clean_start is True and peek() != "init_chain":
+        raise GrammarError(i, str(peek()), "init_chain (clean start)")
+    if clean_start is False and peek() == "init_chain":
+        raise GrammarError(i, "init_chain", "recovery without init_chain")
+    if peek() == "init_chain":
+        i += 1
+    # optional state-sync
+    if peek() == "offer_snapshot":
+        i += 1
+        if peek() != "apply_snapshot_chunk":
+            raise GrammarError(i, str(peek()), "apply_snapshot_chunk")
+        while peek() == "apply_snapshot_chunk":
+            i += 1
+    # consensus-exec: one or more heights
+    heights = 0
+    while i < n:
+        while peek() in _ENTRY:
+            i += 1
+        if peek() != "finalize_block":
+            raise GrammarError(
+                i, str(peek()), "entry*, finalize_block"
+            )
+        i += 1
+        if peek() != "commit":
+            raise GrammarError(i, str(peek()), "commit after finalize_block")
+        i += 1
+        heights += 1
+    if heights == 0 and clean_start is not True:
+        raise GrammarError(i, "end of trace", "at least one consensus height")
+    return heights

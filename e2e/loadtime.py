@@ -1,0 +1,115 @@
+"""Load generation + latency reporting.
+
+The tx payload embeds its creation time; the report walks committed
+blocks, parses the embedded timestamps, and reports per-tx latency
+(block timestamp - creation time) statistics — the same method as the
+reference's loadtime tool (test/loadtime/report/report.go:131: latency
+derived from tx-embedded timestamps vs block time).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import statistics
+import time
+from dataclasses import dataclass
+
+MAGIC = b"ldtm"
+
+
+def make_tx(seq: int, size: int, now_ns: int | None = None) -> bytes:
+    """loadtime tx, kvstore-compatible key=value shape:
+    ``ldtm<seq16x>=<nanos16x><hex padding>`` — unique key per tx, creation
+    time recoverable from the value."""
+    if now_ns is None:
+        now_ns = time.time_ns()
+    head = MAGIC + b"%016x=%016x" % (seq, now_ns)
+    pad = max(0, (size - len(head)) // 2)
+    return head + os.urandom(pad).hex().encode()
+
+
+def parse_tx(tx: bytes):
+    """-> (seq, created_ns) or None for non-loadtime txs."""
+    if len(tx) < 37 or tx[:4] != MAGIC or tx[20:21] != b"=":
+        return None
+    try:
+        return int(tx[4:20], 16), int(tx[21:37], 16)
+    except ValueError:
+        return None
+
+
+def generate(rpc, rate: int, duration_s: float, size: int = 256) -> int:
+    """Fire loadtime txs at ~rate/s for duration_s; returns #accepted."""
+    sent = 0
+    seq = 0
+    deadline = time.monotonic() + duration_s
+    interval = 1.0 / max(rate, 1)
+    next_at = time.monotonic()
+    while time.monotonic() < deadline:
+        try:
+            rpc.broadcast_tx_async(make_tx(seq, size))
+            sent += 1
+        except Exception:
+            pass
+        seq += 1
+        next_at += interval
+        time.sleep(max(0.0, next_at - time.monotonic()))
+    return sent
+
+
+@dataclass
+class Report:
+    txs: int
+    min_s: float
+    max_s: float
+    avg_s: float
+    p50_s: float
+    p99_s: float
+    stddev_s: float
+
+    def __str__(self):
+        return (
+            f"loadtime: {self.txs} txs  "
+            f"avg={self.avg_s*1e3:.0f}ms p50={self.p50_s*1e3:.0f}ms "
+            f"p99={self.p99_s*1e3:.0f}ms min={self.min_s*1e3:.0f}ms "
+            f"max={self.max_s*1e3:.0f}ms stddev={self.stddev_s*1e3:.0f}ms"
+        )
+
+
+def _parse_block_time(s: str) -> float:
+    """RFC3339 with nanoseconds -> unix seconds."""
+    from datetime import datetime, timezone
+
+    s = s.rstrip("Z")
+    if "." in s:
+        main, frac = s.split(".", 1)
+        frac = (frac + "000000000")[:9]
+    else:
+        main, frac = s, "0"
+    dt = datetime.fromisoformat(main).replace(tzinfo=timezone.utc)
+    return dt.timestamp() + int(frac) / 1e9
+
+
+def report(rpc, from_height: int, to_height: int) -> Report | None:
+    """Latency stats over loadtime txs committed in [from, to]."""
+    lat = []
+    for h in range(from_height, to_height + 1):
+        blk = rpc.block(h)["block"]
+        btime = _parse_block_time(blk["header"]["time"])
+        for tx_b64 in blk["data"]["txs"]:
+            parsed = parse_tx(base64.b64decode(tx_b64))
+            if parsed is not None:
+                lat.append(btime - parsed[1] / 1e9)
+    if not lat:
+        return None
+    lat.sort()
+    return Report(
+        txs=len(lat),
+        min_s=lat[0],
+        max_s=lat[-1],
+        avg_s=sum(lat) / len(lat),
+        p50_s=lat[len(lat) // 2],
+        p99_s=lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        stddev_s=statistics.pstdev(lat) if len(lat) > 1 else 0.0,
+    )

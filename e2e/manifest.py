@@ -1,0 +1,82 @@
+"""Testnet manifests: a TOML file describes the network to run.
+
+Reference model: test/e2e/pkg/manifest.go:12-72 (validators, key types,
+ABCI flavor, sync modes, per-node perturbations).
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+
+
+VALID_PERTURBATIONS = {"kill", "pause", "restart", "disconnect"}
+VALID_MODES = {"validator", "full"}
+VALID_ABCI = {"builtin", "socket", "grpc"}
+
+
+@dataclass
+class NodeManifest:
+    name: str
+    mode: str = "validator"  # validator | full
+    key_type: str = "ed25519"  # ed25519 | secp256k1 | bls12_381
+    abci_protocol: str = "builtin"  # builtin | socket | grpc
+    state_sync: bool = False
+    start_at: int = 0  # join at this height (0 = from genesis)
+    perturb: list = field(default_factory=list)  # kill|pause|restart|disconnect
+
+
+@dataclass
+class Manifest:
+    chain_id: str = "e2e-testnet"
+    initial_height: int = 1
+    load_tx_rate: int = 20  # txs/s during the load phase
+    load_tx_bytes: int = 256
+    wait_height: int = 6  # target height for the run phase
+    nodes: list = field(default_factory=list)
+
+    @property
+    def validators(self):
+        return [n for n in self.nodes if n.mode == "validator"]
+
+    def validate(self) -> None:
+        names = set()
+        for n in self.nodes:
+            if n.name in names:
+                raise ValueError(f"duplicate node name {n.name!r}")
+            names.add(n.name)
+            if n.mode not in VALID_MODES:
+                raise ValueError(f"{n.name}: bad mode {n.mode!r}")
+            if n.abci_protocol not in VALID_ABCI:
+                raise ValueError(f"{n.name}: bad abci {n.abci_protocol!r}")
+            for p in n.perturb:
+                if p not in VALID_PERTURBATIONS:
+                    raise ValueError(f"{n.name}: bad perturbation {p!r}")
+        if not any(n.mode == "validator" for n in self.nodes):
+            raise ValueError("manifest has no validators")
+
+
+def load_manifest(path: str) -> Manifest:
+    with open(path, "rb") as f:
+        doc = tomllib.load(f)
+    m = Manifest(
+        chain_id=doc.get("chain_id", "e2e-testnet"),
+        initial_height=doc.get("initial_height", 1),
+        load_tx_rate=doc.get("load_tx_rate", 20),
+        load_tx_bytes=doc.get("load_tx_bytes", 256),
+        wait_height=doc.get("wait_height", 6),
+    )
+    for name, nd in sorted(doc.get("node", {}).items()):
+        m.nodes.append(
+            NodeManifest(
+                name=name,
+                mode=nd.get("mode", "validator"),
+                key_type=nd.get("key_type", "ed25519"),
+                abci_protocol=nd.get("abci_protocol", "builtin"),
+                state_sync=nd.get("state_sync", False),
+                start_at=nd.get("start_at", 0),
+                perturb=list(nd.get("perturb", [])),
+            )
+        )
+    m.validate()
+    return m

@@ -1,0 +1,95 @@
+"""Minimal JSON-RPC-over-HTTP client for the e2e harness and loadtime
+tool (black-box: talks to nodes exactly the way an external user would;
+reference analog: rpc/client/http used by test/e2e/tests)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.request
+
+
+class RPCError(Exception):
+    pass
+
+
+class NodeRPC:
+    def __init__(self, laddr: str, timeout: float = 5.0):
+        # laddr: "tcp://127.0.0.1:26657" or "http://..."
+        hostport = laddr.split("://", 1)[-1]
+        self.base = f"http://{hostport}"
+        self.timeout = timeout
+        self._id = 0
+
+    def call(self, method: str, **params):
+        self._id += 1
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": self._id,
+                "method": method,
+                "params": {k: v for k, v in params.items() if v is not None},
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.base + "/",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            doc = json.loads(resp.read())
+        if doc.get("error"):
+            raise RPCError(str(doc["error"]))
+        return doc["result"]
+
+    # -- conveniences used by the runner/tests ----------------------------
+
+    def status(self):
+        return self.call("status")
+
+    def height(self) -> int:
+        return int(self.status()["sync_info"]["latest_block_height"])
+
+    def block(self, height=None):
+        return self.call("block", height=height)
+
+    def block_results(self, height=None):
+        return self.call("block_results", height=height)
+
+    def commit(self, height=None):
+        return self.call("commit", height=height)
+
+    def validators(self, height=None):
+        return self.call("validators", height=height)
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call(
+            "broadcast_tx_sync", tx=base64.b64encode(tx).decode()
+        )
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self.call(
+            "broadcast_tx_async", tx=base64.b64encode(tx).decode()
+        )
+
+    def tx(self, hash_hex: str):
+        return self.call("tx", hash=hash_hex)
+
+    def wait_for_height(self, h: int, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if self.height() >= h:
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.25)
+        return False
+
+    def is_up(self) -> bool:
+        try:
+            self.status()
+            return True
+        except Exception:
+            return False

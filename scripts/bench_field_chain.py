@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from cometbft_tpu.ops import fe25519 as fe
+from _bench_common import timed as _timed
 
 B = int(os.environ.get("B", "32768"))
 K = int(os.environ.get("K", "400"))
@@ -40,15 +41,9 @@ def chain(op, kernel_mode):
 
 
 def timed(f, v, label):
-    np.asarray(f(v))
-    ts = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        np.asarray(f(v))
-        ts.append(time.perf_counter() - t0)
-    per = min(ts) / K / B * 1e9
-    print(f"{label:24s} {min(ts)*1e3:8.2f} ms  ({per:6.2f} ns/op/lane)")
-    return min(ts)
+    t = _timed(f, args=(v,))
+    print(f"{label:24s} {t*1e3:8.2f} ms  ({t / K / B * 1e9:6.2f} ns/op/lane)")
+    return t
 
 
 def main():

@@ -8,7 +8,6 @@ overhead."""
 import os
 import sys
 import time
-from functools import lru_cache
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
@@ -19,8 +18,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from cometbft_tpu.crypto import ed25519_ref as ref
-from cometbft_tpu.ops import fe25519 as fe, ed25519_point as ep, verify as ov
+from cometbft_tpu.ops import fe25519 as fe, ed25519_point as ep
+from _bench_common import make_sig_dev, timed
 
 B = int(os.environ.get("BENCH_BATCH", "32768"))
 TILE = 256
@@ -95,30 +94,12 @@ def make_stage_kernel(stage: str):
 
 
 def main():
-    distinct = min(B, 1024)
-    pubs, msgs, sigs = [], [], []
-    for i in range(distinct):
-        seed = i.to_bytes(4, "little") * 8
-        pubs.append(ref.pubkey_from_seed(seed))
-        msgs.append(b"bench-%d" % i)
-        sigs.append(ref.sign(seed, b"bench-%d" % i))
-    reps = -(-B // distinct)
-    arrays, _, _ = ov.prepare_batch(
-        (pubs * reps)[:B], (msgs * reps)[:B], (sigs * reps)[:B]
-    )
-    dev = {k: jnp.asarray(v) for k, v in arrays.items()}
+    dev = make_sig_dev(B)
     print(f"platform={jax.devices()[0].platform} B={B}")
 
     prev = 0.0
     for stage in ("decompressA", "decompressAR", "table", "ladder", "full"):
-        f = make_stage_kernel(stage)
-        np.asarray(f(**dev))
-        ts = []
-        for _ in range(7):
-            t0 = time.perf_counter()
-            np.asarray(f(**dev))
-            ts.append(time.perf_counter() - t0)
-        t = min(ts)
+        t = timed(make_stage_kernel(stage), kwargs=dev)
         print(f"{stage:14s} {t*1e3:8.2f} ms   (delta {max(0, t-prev)*1e3:7.2f} ms)")
         prev = t
 

@@ -9,7 +9,6 @@ block.  Cost model target: verify per-sig time ~= (#mul * t_mul +
 import os
 import sys
 import time
-from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
@@ -21,6 +20,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from cometbft_tpu.ops import fe25519 as fe
+from _bench_common import timed as _timed
 
 B = int(os.environ.get("B", "32768"))
 K = int(os.environ.get("K", "400"))
@@ -53,14 +53,8 @@ def make_chain(op):
 
 
 def timed(f, v, label):
-    np.asarray(f(v))
-    ts = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        np.asarray(f(v))
-        ts.append(time.perf_counter() - t0)
-    per = min(ts) / K / B * 1e9
-    print(f"{label:20s} {min(ts)*1e3:8.2f} ms  ({per:6.3f} ns/op/lane)")
+    t = _timed(f, args=(v,))
+    print(f"{label:20s} {t*1e3:8.2f} ms  ({t / K / B * 1e9:6.3f} ns/op/lane)")
 
 
 def main():

@@ -15,40 +15,14 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
-from cometbft_tpu.crypto import ed25519_ref as ref
-from cometbft_tpu.ops import verify as ov
 from cometbft_tpu.ops import pallas_verify as pv
-
-
-def make_dev(n):
-    distinct = min(n, 1024)
-    pubs, msgs, sigs = [], [], []
-    for i in range(distinct):
-        seed = i.to_bytes(4, "little") * 8
-        pubs.append(ref.pubkey_from_seed(seed))
-        msgs.append(b"bench-%d" % i)
-        sigs.append(ref.sign(seed, b"bench-%d" % i))
-    reps = -(-n // distinct)
-    arrays, _, _ = ov.prepare_batch(
-        (pubs * reps)[:n], (msgs * reps)[:n], (sigs * reps)[:n]
-    )
-    return {k: jnp.asarray(v) for k, v in arrays.items()}
+from _bench_common import make_sig_dev as make_dev, timed as _timed
 
 
 def timed(fn, dev, label, reps=7):
-    out = fn(**dev)
-    np.asarray(out)
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        np.asarray(fn(**dev))
-        ts.append(time.perf_counter() - t0)
-    t = min(ts)
-    n = dev["a_bytes"].shape[0]
-    print(f"{label:34s} {t*1e3:9.2f} ms   {n/t/1e3:8.1f} k/s")
-    return t
+    return _timed(fn, kwargs=dev, label=label, reps=reps,
+                  per_n=dev["a_bytes"].shape[0])
 
 
 def main():

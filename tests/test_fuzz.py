@@ -1,0 +1,252 @@
+"""Fuzzers: seeded random-input robustness for the three attack surfaces
+the reference fuzzes (test/fuzz/tests/{mempool,p2p_secretconnection,
+rpc_jsonrpc_server}_test.go) — malformed input must produce clean errors
+or rejections, never hangs, crashes, or accepted garbage.
+
+Default runs are a few hundred cases (CI-sized); set COMETBFT_TPU_FUZZ_N
+for longer campaigns.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import urllib.request
+import urllib.error
+
+import pytest
+
+N = int(os.environ.get("COMETBFT_TPU_FUZZ_N", "300"))
+
+
+def _rng():
+    import random
+
+    return random.Random(0xC0FFEE)
+
+
+# ---------------------------------------------------------------------------
+# Mempool CheckTx (reference: test/fuzz/mempool)
+# ---------------------------------------------------------------------------
+
+
+class TestFuzzMempool:
+    def test_checktx_random_bytes(self):
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.config.config import MempoolConfig
+        from cometbft_tpu.mempool.clist_mempool import CListMempool
+        from cometbft_tpu.proxy.multi_app_conn import (
+            AppConns,
+            local_client_creator,
+        )
+
+        conns = AppConns(local_client_creator(KVStoreApplication()))
+        conns.start()
+        mp = CListMempool(MempoolConfig(), conns.mempool)
+        rng = _rng()
+        added = 0
+        for i in range(N):
+            n = rng.randrange(0, 2048)
+            tx = rng.randbytes(n)
+            try:
+                resp = mp.check_tx(tx)
+                added += int(resp.code == 0)
+            except Exception as e:  # noqa: BLE001 — must be a *clean* error
+                assert type(e).__name__ in (
+                    "MempoolError",
+                ), f"unexpected {type(e).__name__}: {e}"
+        # duplicates / empties may be rejected, but the mempool must stay
+        # consistent: size equals live txs, reap round-trips
+        assert mp.size() <= added
+        mp.reap_max_bytes_max_gas(10 << 20, -1)
+        conns.stop()
+
+
+# ---------------------------------------------------------------------------
+# SecretConnection (reference: test/fuzz/p2p/secretconnection)
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedSock:
+    """socket-like object replaying a scripted byte stream."""
+
+    def __init__(self, script: bytes):
+        self._buf = script
+        self.sent = b""
+
+    def sendall(self, b):
+        self.sent += bytes(b)
+
+    def recv(self, n):
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def settimeout(self, t):
+        pass
+
+
+class TestFuzzSecretConnection:
+    def test_handshake_random_garbage(self):
+        """Random bytes in place of the remote handshake: constructor must
+        raise SecretConnectionError (or detect truncation), never accept."""
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+        from cometbft_tpu.p2p.secret_connection import (
+            SecretConnection,
+            SecretConnectionError,
+        )
+
+        rng = _rng()
+        key = Ed25519PrivKey.from_seed(b"\x01" * 32)
+        for i in range(min(N, 150)):
+            script = rng.randbytes(rng.randrange(0, 256))
+            with pytest.raises(Exception) as ei:
+                SecretConnection(_ScriptedSock(script), key)
+            assert isinstance(
+                ei.value, (SecretConnectionError, ValueError, OSError)
+            ), f"case {i}: {type(ei.value).__name__}: {ei.value}"
+
+    def test_frame_corruption_detected(self):
+        """Bit-flips in sealed frames must fail AEAD authentication."""
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+        from cometbft_tpu.p2p.secret_connection import (
+            SecretConnection,
+            SecretConnectionError,
+        )
+
+        a_sock, b_sock = socket.socketpair()
+        ka = Ed25519PrivKey.from_seed(b"\x02" * 32)
+        kb = Ed25519PrivKey.from_seed(b"\x03" * 32)
+        res = {}
+
+        def srv():
+            res["b"] = SecretConnection(b_sock, kb)
+
+        t = threading.Thread(target=srv)
+        t.start()
+        sca = SecretConnection(a_sock, ka)
+        t.join()
+        scb = res["b"]
+
+        rng = _rng()
+        for i in range(min(N, 100)):
+            payload = rng.randbytes(rng.randrange(1, 900))
+            sca.write_frame(payload)
+            # receive the sealed frame off the wire and corrupt one byte
+            hdr = b""
+            while len(hdr) < 4:
+                hdr += b_sock.recv(4 - len(hdr))
+            (ln,) = struct.unpack(">I", hdr)
+            sealed = b""
+            while len(sealed) < ln:
+                sealed += b_sock.recv(ln - len(sealed))
+            pos = rng.randrange(len(sealed))
+            bad = bytearray(sealed)
+            bad[pos] ^= 1 << rng.randrange(8)
+            scb._recv_buf = b""
+            with pytest.raises(SecretConnectionError):
+                scb._recv_buf = hdr + bytes(bad)
+                scb.read_frame()
+            # AEAD nonce advanced on the failed open; resync both sides by
+            # sealing fresh on a new connection pair would be needed for
+            # continued traffic — corruption is fatal per connection, as in
+            # the reference.  Re-handshake for the next case:
+            a_sock.close()
+            b_sock.close()
+            a_sock2, b_sock2 = socket.socketpair()
+            t = threading.Thread(target=lambda: res.update(
+                b=SecretConnection(b_sock2, kb)))
+            t.start()
+            sca = SecretConnection(a_sock2, ka)
+            t.join()
+            scb = res["b"]
+            a_sock, b_sock = a_sock2, b_sock2
+            if i >= 20:  # full re-handshake per case is slow; 20 suffices
+                break
+        a_sock.close()
+        b_sock.close()
+
+
+# ---------------------------------------------------------------------------
+# JSON-RPC server (reference: test/fuzz/rpc/jsonrpc/server)
+# ---------------------------------------------------------------------------
+
+
+class TestFuzzJSONRPC:
+    @pytest.fixture(scope="class")
+    def server_port(self, tmp_path_factory):
+        """A full single-validator node with RPC on an ephemeral port."""
+        from cometbft_tpu.cmd.main import main as cli_main
+        from cometbft_tpu.config import config as cfgmod
+        from cometbft_tpu.node.node import Node
+
+        home = str(tmp_path_factory.mktemp("fuzzrpc") / "node")
+        assert cli_main(["--home", home, "init", "--chain-id", "fuzz-chain"]) == 0
+        cfg = cfgmod.load_config(home)
+        cfg.base.home = home
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 100
+        node = Node(cfg)
+        node.start()
+        yield node.rpc_server.bound_port
+        node.stop()
+
+    def test_random_bodies(self, server_port):
+        rng = _rng()
+        url = f"http://127.0.0.1:{server_port}/"
+        cases = []
+        for _ in range(min(N, 200)):
+            kind = rng.randrange(5)
+            if kind == 0:
+                body = rng.randbytes(rng.randrange(0, 512))  # raw garbage
+            elif kind == 1:
+                body = json.dumps(
+                    {"jsonrpc": "2.0", "id": 1, "method": "x" * rng.randrange(1, 60)}
+                ).encode()
+            elif kind == 2:
+                body = json.dumps(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": 1,
+                        "method": "block",
+                        "params": {"height": rng.choice(
+                            [-1, 0, 2**63, "NaN", [], {}, None]
+                        )},
+                    }
+                ).encode()
+            elif kind == 3:
+                body = b'{"jsonrpc": "2.0", "id": 1, "method": "tx", "params": {"hash": "' + rng.randbytes(8).hex().encode() + b'"}}'
+            else:
+                body = b"[" * rng.randrange(1, 2000)  # parser bomb
+            cases.append(body)
+        for body in cases:
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    doc = json.loads(resp.read())
+                    # if HTTP 200, it must be a well-formed JSON-RPC reply
+                    assert "error" in doc or "result" in doc
+            except urllib.error.HTTPError as e:
+                assert 400 <= e.code < 600
+            except (
+                urllib.error.URLError,
+                TimeoutError,
+                json.JSONDecodeError,
+            ) as e:
+                pytest.fail(f"server broke on {body[:40]!r}: {e}")
+        # the server is still alive and sane
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": 9, "method": "health", "params": {}}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["result"] == {}

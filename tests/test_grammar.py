@@ -1,0 +1,100 @@
+"""ABCI grammar checker: unit cases + a recorded live-node trace.
+
+Reference model: test/e2e/pkg/grammar — the checker validates the
+sequence of consensus/snapshot-connection ABCI calls a node makes.
+"""
+
+import pytest
+
+from e2e import grammar
+
+
+class TestGrammarUnit:
+    def test_clean_start(self):
+        t = ["init_chain"] + [
+            "prepare_proposal", "process_proposal", "finalize_block", "commit",
+        ] * 3
+        assert grammar.check(t, clean_start=True) == 3
+
+    def test_recovery(self):
+        t = ["process_proposal", "finalize_block", "commit",
+             "finalize_block", "commit"]
+        assert grammar.check(t, clean_start=False) == 2
+
+    def test_statesync(self):
+        t = (["init_chain", "offer_snapshot"]
+             + ["apply_snapshot_chunk"] * 4
+             + ["finalize_block", "commit"])
+        assert grammar.check(t) == 1
+
+    def test_vote_extensions_entries(self):
+        t = ["init_chain", "prepare_proposal", "process_proposal",
+             "extend_vote", "verify_vote_extension", "verify_vote_extension",
+             "finalize_block", "commit"]
+        assert grammar.check(t) == 1
+
+    def test_rejects_commit_without_finalize(self):
+        with pytest.raises(grammar.GrammarError):
+            grammar.check(["init_chain", "commit"])
+
+    def test_rejects_double_finalize(self):
+        with pytest.raises(grammar.GrammarError):
+            grammar.check(
+                ["init_chain", "finalize_block", "finalize_block", "commit"]
+            )
+
+    def test_rejects_entry_after_finalize(self):
+        with pytest.raises(grammar.GrammarError):
+            grammar.check(
+                ["init_chain", "finalize_block", "prepare_proposal", "commit"]
+            )
+
+    def test_rejects_snapshot_without_chunks(self):
+        with pytest.raises(grammar.GrammarError):
+            grammar.check(
+                ["init_chain", "offer_snapshot", "finalize_block", "commit"]
+            )
+
+    def test_recovery_forbids_init_chain(self):
+        with pytest.raises(grammar.GrammarError):
+            grammar.check(
+                ["init_chain", "finalize_block", "commit"], clean_start=False
+            )
+
+
+class TestGrammarLiveNode:
+    def test_node_trace_conforms(self, tmp_path):
+        """Boot a real node with the recording proxy wrapped around the
+        kvstore app; the recorded consensus-connection trace must parse."""
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.cmd.main import main as cli_main
+        from cometbft_tpu.config import config as cfgmod
+        from cometbft_tpu.node.node import Node
+        import time
+
+        home = str(tmp_path / "node")
+        assert cli_main(
+            ["--home", home, "init", "--chain-id", "grammar-chain"]
+        ) == 0
+        cfg = cfgmod.load_config(home)
+        cfg.base.home = home
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus.timeout_commit_ms = 50
+
+        rec = grammar.Recorder()
+        app = grammar.recording_app(KVStoreApplication(), rec)
+        node = Node(cfg, app=app)
+        node.start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if node.block_store.height() >= 4:
+                    break
+                time.sleep(0.05)
+            assert node.block_store.height() >= 4
+        finally:
+            node.stop()
+        heights = grammar.check(list(rec.trace), clean_start=True)
+        assert heights >= 4
