@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from cometbft_tpu.libs import sync as libsync
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -50,7 +52,7 @@ class BlockPool:
         self.height = start_height  # next height to pop
         self.send_request = send_request
         self.logger = logger or liblog.nop_logger()
-        self._lock = threading.RLock()
+        self._lock = libsync.rlock("blocksync.pool")
         self.peers: dict[str, _PeerData] = {}
         self.requests: dict[int, _Request] = {}
         self.ever_had_peers = False

@@ -15,6 +15,8 @@ votes, and retransmitting anything the fast path missed.
 from __future__ import annotations
 
 import threading
+
+from cometbft_tpu.libs import sync as libsync
 import time
 from typing import Optional
 
@@ -78,7 +80,7 @@ class PeerState:
 
     def __init__(self, peer):
         self.peer = peer
-        self.lock = threading.RLock()
+        self.lock = libsync.rlock("consensus.reactor.peer_state")
         self.height = 0
         self.round_ = -1
         self.step = STEP_NEW_HEIGHT
@@ -197,7 +199,7 @@ class ConsensusReactor(Reactor):
         self.logger = logger or liblog.nop_logger()
         self.wait_sync = wait_sync  # True until blocksync/statesync finish
         self._peer_states: dict[str, PeerState] = {}
-        self._ps_lock = threading.Lock()
+        self._ps_lock = libsync.lock("consensus.reactor")
         cs.broadcast_hook = self._broadcast_internal
         cs.add_step_listener(self._on_new_step)
         cs.add_vote_listener(self._on_vote_added)

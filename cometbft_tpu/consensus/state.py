@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import queue
 import threading
+
+from cometbft_tpu.libs import sync as libsync
 import time as _time
 from typing import Callable, Optional
 
@@ -97,7 +99,7 @@ class ConsensusState(BaseService):
         self.rs = RoundState()
         self.state: Optional[State] = None
 
-        self._mtx = threading.RLock()
+        self._mtx = libsync.rlock("consensus.state")
         self._queue: "queue.Queue[tuple[str, object]]" = queue.Queue(maxsize=1000)
         self.ticker = TimeoutTicker(self._tock)
         self._thread: Optional[threading.Thread] = None

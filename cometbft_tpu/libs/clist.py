@@ -10,6 +10,8 @@ removed element keeps working) so iterators never see a torn list.
 from __future__ import annotations
 
 import threading
+
+from cometbft_tpu.libs import sync as libsync
 from typing import Any, Iterator, Optional
 
 
@@ -54,8 +56,8 @@ class CElement:
 
 class CList:
     def __init__(self):
-        self._mtx = threading.RLock()
-        self._cond = threading.Condition(self._mtx)
+        self._mtx = libsync.rlock("clist")
+        self._cond = libsync.condition(self._mtx)
         self._head: Optional[CElement] = None
         self._tail: Optional[CElement] = None
         self._len = 0

@@ -18,6 +18,8 @@ from __future__ import annotations
 import queue
 import re
 import threading
+
+from cometbft_tpu.libs import sync as libsync
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -191,7 +193,7 @@ class PubSubServer:
 
     def __init__(self):
         self._subs: dict[tuple[str, str], Subscription] = {}
-        self._mtx = threading.RLock()
+        self._mtx = libsync.rlock("pubsub")
 
     def subscribe(
         self, subscriber: str, query: Query, capacity: int = 100

@@ -11,6 +11,8 @@ visits lanes in priority order round-robin (high first); after a block commits,
 from __future__ import annotations
 
 import threading
+
+from cometbft_tpu.libs import sync as libsync
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Sequence
@@ -141,7 +143,7 @@ class CListMempool:
         )
         self.lanes: dict[str, CList] = {l: CList() for l in lane_priorities}
         self._tx_map: dict[bytes, CElement] = {}
-        self._mtx = threading.RLock()  # held across Update (reference Lock())
+        self._mtx = libsync.rlock("mempool")  # held across Update (reference Lock())
         self._total_bytes = 0
         self._notified_available = False
         self._txs_available: Optional[threading.Event] = None

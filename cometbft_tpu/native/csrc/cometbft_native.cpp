@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <cstdlib>
 #include <fcntl.h>
 #include <unistd.h>
@@ -24,20 +25,21 @@
 // ---------------------------------------------------------------------------
 
 static uint32_t crc_table[256];
-static bool crc_init_done = false;
 
-static void crc_init() {
+static int crc_init() {
     for (uint32_t i = 0; i < 256; i++) {
         uint32_t c = i;
         for (int k = 0; k < 8; k++)
             c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
         crc_table[i] = c;
     }
-    crc_init_done = true;
+    return 0;
 }
 
 static uint32_t crc32_of(const uint8_t* buf, size_t len) {
-    if (!crc_init_done) crc_init();
+    // magic static: guaranteed one-time, thread-safe initialization
+    static const int crc_ready = crc_init();
+    (void)crc_ready;
     uint32_t c = 0xFFFFFFFFu;
     for (size_t i = 0; i < len; i++)
         c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
@@ -51,6 +53,7 @@ static uint32_t crc32_of(const uint8_t* buf, size_t len) {
 struct Wal {
     int fd;
     int64_t size;
+    std::mutex mtx;  // appends must be whole-frame atomic across threads
 };
 
 extern "C" {
@@ -80,14 +83,20 @@ int wal_append(void* h, int kind, const uint8_t* data, int64_t len, int sync) {
         frame[4 + i] = (blen >> (24 - 8 * i)) & 0xFF;
     }
     size_t total = 8 + body_len;
-    size_t off = 0;
-    while (off < total) {
-        ssize_t nw = ::write(w->fd, frame + off, total - off);
-        if (nw < 0) { free(frame); return -1; }
-        off += static_cast<size_t>(nw);
+    {
+        // hold the lock across the partial-write loop: a frame must hit
+        // the file contiguously even if write() returns short (TSAN
+        // stress gate: scripts/sanitize_native.sh)
+        std::lock_guard<std::mutex> g(w->mtx);
+        size_t off = 0;
+        while (off < total) {
+            ssize_t nw = ::write(w->fd, frame + off, total - off);
+            if (nw < 0) { free(frame); return -1; }
+            off += static_cast<size_t>(nw);
+        }
+        w->size += static_cast<int64_t>(total);
     }
     free(frame);
-    w->size += static_cast<int64_t>(total);
     if (sync && ::fsync(w->fd) != 0) return -1;
     return 0;
 }
@@ -99,7 +108,9 @@ int wal_sync(void* h) {
 
 int64_t wal_size(void* h) {
     Wal* w = static_cast<Wal*>(h);
-    return w ? w->size : -1;
+    if (!w) return -1;
+    std::lock_guard<std::mutex> g(w->mtx);
+    return w->size;
 }
 
 void wal_close(void* h) {

@@ -191,7 +191,9 @@ class RPCServer:
             return _rpc_response(
                 id_, error={"code": -32600, "message": "method must be a string"}
             )
-        params = req.get("params") or {}
+        params = req.get("params")
+        if params is None:
+            params = {}
         if not isinstance(params, (dict, list)):
             return _rpc_response(
                 id_, error={"code": -32602, "message": "params must be an object"}

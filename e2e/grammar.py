@@ -105,16 +105,23 @@ def check(trace: list[str], clean_start: bool | None = None) -> int:
             raise GrammarError(i, str(peek()), "apply_snapshot_chunk")
         while peek() == "apply_snapshot_chunk":
             i += 1
-    # consensus-exec: one or more heights
+    # consensus-exec: one or more heights.  A live node stopped mid-height
+    # legitimately truncates the trace after some entries or after a
+    # finalize_block whose commit had not landed yet — accept that tail
+    # (the reference checker likewise only validates completed heights).
     heights = 0
     while i < n:
         while peek() in _ENTRY:
             i += 1
+        if peek() is None:
+            break  # truncated inside a height's entry phase
         if peek() != "finalize_block":
             raise GrammarError(
                 i, str(peek()), "entry*, finalize_block"
             )
         i += 1
+        if peek() is None:
+            break  # truncated between finalize_block and commit
         if peek() != "commit":
             raise GrammarError(i, str(peek()), "commit after finalize_block")
         i += 1

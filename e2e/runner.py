@@ -44,10 +44,25 @@ class RunningNode:
     node_id: str = ""
     proc: subprocess.Popen | None = None
     log_path: str = ""
+    app_proc: subprocess.Popen | None = None  # socket/grpc ABCI app
+    app_laddr: str = ""
 
     @property
     def rpc(self) -> NodeRPC:
         return NodeRPC(self.rpc_laddr)
+
+
+_APP_SERVER_SNIPPET = """
+import sys, time
+sys.path.insert(0, {repo!r})
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+{import_line}
+srv = {server_expr}
+srv.start()
+print("abci app listening", flush=True)
+while True:
+    time.sleep(1)
+"""
 
 
 class Testnet:
@@ -69,6 +84,13 @@ class Testnet:
 
         pvs = {}
         for nm in self.manifest.nodes:
+            if nm.key_type != "ed25519":
+                # FilePV generation is ed25519-only today; failing loudly
+                # beats silently running the wrong key type
+                raise NotImplementedError(
+                    f"{nm.name}: e2e validator key_type {nm.key_type!r} "
+                    "not supported (FilePV generates ed25519)"
+                )
             home = os.path.join(self.workdir, nm.name)
             cfg = cfgmod.default_config()
             cfg.base.home = home
@@ -77,6 +99,18 @@ class Testnet:
             cfg.base.db_backend = "sqlite"  # must survive kill -9
             cfg.consensus.timeout_commit_ms = 200
             cfg.consensus.timeout_propose_ms = 2000
+            if nm.abci_protocol in ("socket", "grpc"):
+                app_port = _free_port()
+                cfg.base.abci = nm.abci_protocol
+                cfg.base.proxy_app = f"tcp://127.0.0.1:{app_port}"
+            if nm.state_sync:
+                if nm.start_at == 0:
+                    raise ValueError(
+                        f"{nm.name}: state_sync requires start_at > 0 "
+                        "(a fresh late joiner)"
+                    )
+                # enable + trust parameters are filled in at join time
+                # from the live network (start_late_joiners)
             cfgmod.write_config(cfg)
             pv = FilePV.load_or_generate(
                 os.path.join(home, cfg.base.priv_validator_key_file),
@@ -122,22 +156,63 @@ class Testnet:
 
     # -- start / stop -----------------------------------------------------
 
-    def start_node(self, node: RunningNode) -> None:
+    @staticmethod
+    def _child_env() -> dict:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         # sitecustomize in axon environments overrides JAX_PLATFORMS; the
         # CLI re-pins at the jax.config level from this variable
         env.setdefault("COMETBFT_TPU_JAX_PLATFORM", "cpu")
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        logf = open(node.log_path, "ab")
-        node.proc = subprocess.Popen(
-            [sys.executable, "-m", "cometbft_tpu.cmd",
-             "--home", node.home, "start"],
-            stdout=logf,
-            stderr=subprocess.STDOUT,
-            env=env,
-            cwd=REPO,
+        return env
+
+    def _maybe_start_app(self, node: RunningNode) -> None:
+        """For socket/grpc ABCI manifests: the app is its own OS process
+        (the reference's separate-container app), serving kvstore."""
+        proto = node.manifest.abci_protocol
+        if proto == "builtin" or (
+            node.app_proc is not None and node.app_proc.poll() is None
+        ):
+            return
+        from cometbft_tpu.config import config as cfgmod
+
+        cfg = cfgmod.load_config(node.home)
+        addr = cfg.base.proxy_app
+        if proto == "socket":
+            import_line = "from cometbft_tpu.abci.server import ABCIServer"
+            server_expr = f"ABCIServer(KVStoreApplication(), {addr!r})"
+        else:
+            import_line = (
+                "from cometbft_tpu.abci.grpc_abci import GRPCABCIServer"
+            )
+            server_expr = f"GRPCABCIServer(KVStoreApplication(), {addr!r})"
+        code = _APP_SERVER_SNIPPET.format(
+            repo=REPO, import_line=import_line, server_expr=server_expr
         )
+        with open(node.log_path.replace(".log", "-app.log"), "ab") as logf:
+            node.app_proc = subprocess.Popen(
+                [sys.executable, "-c", code],
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=self._child_env(),
+                cwd=REPO,
+            )
+        node.app_laddr = addr
+        time.sleep(1.0)  # let the app bind before the node dials
+
+    def start_node(self, node: RunningNode) -> None:
+        self._maybe_start_app(node)
+        # the 'ab' handle is only for Popen inheritance; the child keeps
+        # its own duplicate, so close ours (no fd leak across restarts)
+        with open(node.log_path, "ab") as logf:
+            node.proc = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu.cmd",
+                 "--home", node.home, "start"],
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=self._child_env(),
+                cwd=REPO,
+            )
 
     def start(self, timeout: float = 120.0) -> None:
         for node in self.nodes:
@@ -147,10 +222,16 @@ class Testnet:
         for node in self.nodes:
             if node.proc is None:
                 continue
-            while time.monotonic() < deadline:
+            retries = 2  # _free_port is bind/close/reuse: a stolen port
+            while time.monotonic() < deadline:  # shows as instant exit
                 if node.rpc.is_up():
                     break
                 if node.proc.poll() is not None:
+                    if retries > 0:
+                        retries -= 1
+                        time.sleep(0.5)
+                        self.start_node(node)
+                        continue
                     raise RuntimeError(
                         f"{node.manifest.name} exited rc={node.proc.returncode}"
                         f" (log: {node.log_path})"
@@ -161,15 +242,18 @@ class Testnet:
 
     def stop(self) -> None:
         for node in self.nodes:
-            if node.proc and node.proc.poll() is None:
-                node.proc.send_signal(signal.SIGTERM)
+            for proc in (node.proc, node.app_proc):
+                if proc and proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
         for node in self.nodes:
-            if node.proc:
+            for proc in (node.proc, node.app_proc):
+                if proc is None:
+                    continue
                 try:
-                    node.proc.wait(timeout=15)
+                    proc.wait(timeout=15)
                 except subprocess.TimeoutExpired:
-                    node.proc.kill()
-                    node.proc.wait(timeout=5)
+                    proc.kill()
+                    proc.wait(timeout=5)
 
     # -- phases -----------------------------------------------------------
 
@@ -207,6 +291,8 @@ class Testnet:
                     f"network never reached start_at="
                     f"{node.manifest.start_at} for {node.manifest.name}"
                 )
+            if node.manifest.state_sync:
+                self._configure_state_sync(node, running)
             self.start_node(node)
             if not node.rpc.wait_for_height(
                 node.manifest.start_at, timeout=timeout
@@ -214,6 +300,26 @@ class Testnet:
                 raise TimeoutError(
                     f"late joiner {node.manifest.name} failed to catch up"
                 )
+
+    def _configure_state_sync(self, node: RunningNode, running) -> None:
+        """Fill the joiner's statesync config from the live network:
+        >=2 RPC servers and a trusted header (reference: the operator
+        copies trust_height/hash from a trusted RPC before boot)."""
+        from cometbft_tpu.config import config as cfgmod
+
+        src = running[0].rpc
+        h = max(1, src.height() - 2)
+        commit = src.commit(h)
+        trust_hash = commit["signed_header"]["commit"]["block_id"]["hash"]
+        cfg = cfgmod.load_config(node.home)
+        cfg.statesync.enable = True
+        cfg.statesync.rpc_servers = [
+            n.rpc_laddr for n in (running * 2)[:2]
+        ]
+        cfg.statesync.trust_height = h
+        cfg.statesync.trust_hash = trust_hash
+        cfg.statesync.discovery_time_s = 3
+        cfgmod.write_config(cfg)
 
     def load(self, duration_s: float) -> int:
         rpc = self.nodes[0].rpc

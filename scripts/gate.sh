@@ -10,14 +10,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/3: pytest =="
+echo "== gate 1/4: pytest =="
 python -m pytest tests/ -x -q
 
-echo "== gate 2/3: bench.py =="
+echo "== gate 2/4: bench.py =="
 python bench.py
 
-echo "== gate 3/3: dryrun_multichip(8) =="
+echo "== gate 3/4: dryrun_multichip(8) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== gate 4/4: native sanitizers (TSAN+ASAN) =="
+bash scripts/sanitize_native.sh
 
 echo "gate: ALL GREEN"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Sanitizer gate for the native C++ runtime (the TSAN analog of the
+# reference's `go test -race` CI discipline, tests.mk:56).
+#
+#   scripts/sanitize_native.sh            # thread + address, both run
+#   scripts/sanitize_native.sh thread     # one sanitizer only
+#
+# Builds csrc/{cometbft_native,native_stress}.cpp into a standalone
+# binary per sanitizer and runs the concurrent stress driver; any data
+# race / UB report fails the script via the sanitizer's nonzero exit.
+set -euo pipefail
+cd "$(dirname "$0")/../cometbft_tpu/native/csrc"
+
+SANS=${1:-"thread address"}
+for SAN in $SANS; do
+  out="/tmp/native_stress_${SAN}"
+  echo "== build -fsanitize=${SAN} =="
+  g++ -O1 -g -std=c++17 -fsanitize="${SAN}" -fno-omit-frame-pointer \
+      cometbft_native.cpp native_stress.cpp -o "${out}" -lpthread
+  echo "== run (${SAN}) =="
+  "${out}" "/tmp/native_stress_${SAN}.wal"
+done
+echo "sanitize_native: ALL CLEAN"

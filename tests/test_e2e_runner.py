@@ -42,6 +42,29 @@ class TestManifest:
 
 
 class TestProcessE2E:
+    def test_socket_abci_node(self, tmp_path):
+        """One validator runs its kvstore app as a SEPARATE process over
+        the socket ABCI flavor (reference: e2e abci_protocol=socket)."""
+        m = Manifest(
+            chain_id="e2e-socket",
+            wait_height=3,
+            nodes=[
+                NodeManifest(name="v1"),
+                NodeManifest(name="v2", abci_protocol="socket"),
+            ],
+        )
+        net = Testnet(m, str(tmp_path))
+        net.setup()
+        try:
+            net.start()
+            net.wait_height(3, timeout=120)
+            assert net.nodes[1].app_proc is not None
+            assert net.nodes[1].app_proc.poll() is None
+            inv = net.run_invariants()
+            assert inv["min_height"] >= 3
+        finally:
+            net.stop()
+
     def test_kill_restart_pipeline(self, tmp_path):
         """3 validators as processes; kill -9 one, restart, verify chain
         invariants + loadtime report."""

@@ -1,0 +1,62 @@
+"""Run the bounded consensus-safety model checker (spec/model/ — the
+runnable analog of the reference's spec/ivy-proofs)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "spec"),
+)
+
+from model.tendermint_model import (  # noqa: E402
+    ModelConfig,
+    check_agreement,
+    check_agreement_violated_with_excess_byzantine,
+    check_quorum_accountability,
+    check_unlock_rule_necessity,
+    quorum,
+)
+
+
+class TestQuorumAccountability:
+    def test_small_ns(self):
+        for n in (4, 5, 6, 7):
+            check_quorum_accountability(n)
+
+    def test_quorum_size(self):
+        assert quorum(4) == 3
+        assert quorum(6) == 5
+        assert quorum(7) == 5
+
+
+class TestAgreement:
+    def test_n4_f1_two_rounds(self):
+        assert check_agreement(ModelConfig(n=4, byz=(3,), rounds=2)) > 0
+
+    def test_n4_f1_three_rounds(self):
+        assert check_agreement(ModelConfig(n=4, byz=(3,), rounds=3)) > 0
+
+    def test_n4_f1_byz_first_proposer(self):
+        # byzantine validator 0 proposes round 0 with per-receiver values
+        assert check_agreement(ModelConfig(n=4, byz=(0,), rounds=2)) > 0
+
+    @pytest.mark.skipif(
+        not os.environ.get("COMETBFT_TPU_SLOW_TESTS"),
+        reason="n=7 exploration takes a few seconds; slow-tests only",
+    )
+    def test_n7_f2(self):
+        assert check_agreement(ModelConfig(n=7, byz=(5, 6), rounds=2)) > 0
+
+
+class TestCheckerNotVacuous:
+    """The checker must FIND violations when the preconditions break —
+    otherwise a green agreement run means nothing."""
+
+    def test_excess_byzantine_violates(self):
+        assert check_agreement_violated_with_excess_byzantine()
+
+    def test_lock_rules_carry_safety(self):
+        assert check_unlock_rule_necessity()

@@ -1,0 +1,98 @@
+"""Deadlock-detecting lock mode (reference: libs/sync + go-deadlock
+behind the `deadlock` build tag)."""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.libs import sync as libsync
+
+
+@pytest.fixture
+def watchdog_env(monkeypatch):
+    monkeypatch.setenv("COMETBFT_TPU_DEADLOCK", "1")
+    monkeypatch.setenv("COMETBFT_TPU_DEADLOCK_TIMEOUT", "0.5")
+
+
+class TestWatchdogLocks:
+    def test_disabled_returns_raw_locks(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_DEADLOCK", raising=False)
+        assert not isinstance(libsync.lock(), libsync._WatchdogLock)
+        assert not isinstance(libsync.rlock(), libsync._WatchdogLock)
+
+    def test_normal_use(self, watchdog_env):
+        lk = libsync.rlock("t")
+        with lk:
+            with lk:  # re-entrant
+                pass
+        assert lk.acquire(blocking=False)
+        lk.release()
+
+    def test_ab_ba_deadlock_detected(self, watchdog_env):
+        """Classic AB/BA cycle: the watchdog must raise with stacks
+        instead of hanging forever."""
+        a, b = libsync.lock("A"), libsync.lock("B")
+        started = threading.Event()
+        errors = []
+
+        def t1():
+            with a:
+                started.wait(2)
+                time.sleep(0.1)
+                try:
+                    with b:
+                        pass
+                except libsync.DeadlockError as e:
+                    errors.append(e)
+
+        def t2():
+            with b:
+                started.set()
+                try:
+                    with a:
+                        pass
+                except libsync.DeadlockError as e:
+                    errors.append(e)
+
+        th1 = threading.Thread(target=t1)
+        th2 = threading.Thread(target=t2)
+        th1.start()
+        th2.start()
+        th1.join(10)
+        th2.join(10)
+        assert not th1.is_alive() and not th2.is_alive()
+        assert errors, "deadlock went undetected"
+        assert "thread stacks" in str(errors[0]).lower() or "---" in str(
+            errors[0]
+        )
+
+    def test_condition_over_watchdog_lock(self, watchdog_env):
+        lk = libsync.rlock("c")
+        cond = libsync.condition(lk)
+        hits = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                hits.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        with cond:
+            cond.notify()
+        t.join(5)
+        assert hits == [1]
+
+    def test_clist_under_watchdog(self, watchdog_env):
+        """The swapped components still work in watchdog mode."""
+        import importlib
+
+        from cometbft_tpu.libs import clist as clist_mod
+
+        cl = clist_mod.CList()
+        e = cl.push_back(b"x")
+        assert cl.front() is e
+        cl.remove(e)
+        assert cl.front() is None
