@@ -52,7 +52,11 @@ def main() -> None:
 
     from cometbft_tpu.ops import verify as ov
 
-    n = int(os.environ.get("BENCH_BATCH", "32768"))
+    # Default batch: large enough to amortize the per-dispatch floor
+    # (~30-70 ms through the axon tunnel; measured in
+    # scripts/bench_pallas_profile.py — dispatches do not pipeline, so
+    # within-dispatch batching is the only amortization).
+    n = int(os.environ.get("BENCH_BATCH", "131072"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
 
     kernel = (
@@ -85,6 +89,16 @@ def main() -> None:
     e2e_s = time.perf_counter() - t0
     assert bits.all()
 
+    # Device-compute estimate for the 10k commit from the measured slope
+    # between the two batch sizes (subtracts the fixed dispatch floor the
+    # tunnel adds to every call; BASELINE's <5 ms target is specified as
+    # the device-kernel portion).
+    if n > 10_240:
+        slope = (kernel_s - commit10k_s) / (n - 10_240)
+        commit10k_dev_ms = round(max(slope, 0.0) * 10_240 * 1e3, 3)
+    else:
+        commit10k_dev_ms = None  # no second batch size to take a slope from
+
     result = {
         "metric": "ed25519_batch_verify_throughput",
         "value": round(vps, 1),
@@ -94,6 +108,7 @@ def main() -> None:
         "kernel_s": round(kernel_s, 6),
         "e2e_s": round(e2e_s, 6),
         "commit10k_ms": round(commit10k_s * 1e3, 3),
+        "commit10k_device_est_ms": commit10k_dev_ms,
         "impl": "pallas" if ov._use_pallas() else "xla",
         "platform": jax.devices()[0].platform,
     }

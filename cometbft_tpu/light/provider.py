@@ -143,6 +143,12 @@ class HTTPProvider(Provider):
 
     def __init__(self, chain_id: str, base_url: str, timeout: float = 10.0):
         self._chain_id = chain_id
+        # accept the reference config's address styles: bare host:port and
+        # tcp:// both mean plain HTTP (config/config.go rpc_servers)
+        if base_url.startswith("tcp://"):
+            base_url = "http://" + base_url[len("tcp://"):]
+        elif "://" not in base_url:
+            base_url = "http://" + base_url
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
 

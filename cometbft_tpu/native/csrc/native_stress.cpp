@@ -30,6 +30,26 @@ int ed25519_pack(const uint8_t* pubs, const uint8_t* sigs, const uint8_t* msgs,
 
 static std::atomic<int> failures{0};
 
+// zlib CRC32, same polynomial/table construction as cometbft_native.cpp —
+// recomputed here so the verifier is independent of the code under test
+static uint32_t crc32_zlib(const uint8_t* buf, size_t len) {
+  static uint32_t table[256];
+  static bool ready = [] {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)ready;
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 static void wal_writer(void* h, int tid, int iters) {
   std::string payload = "record-from-thread-" + std::to_string(tid);
   for (int i = 0; i < iters; i++) {
@@ -100,6 +120,13 @@ int main(int argc, char** argv) {
     if (std::fread(payload.data(), 1, len, f) != len) {
       std::fprintf(stderr, "torn payload after %d records\n", records);
       return 7;
+    }
+    uint32_t want = ((uint32_t)hdr[0] << 24) | ((uint32_t)hdr[1] << 16) |
+                    ((uint32_t)hdr[2] << 8) | (uint32_t)hdr[3];
+    if (crc32_zlib(payload.data(), payload.size()) != want) {
+      std::fprintf(stderr, "CRC mismatch in record %d (interleaved "
+                   "payload bytes?)\n", records);
+      return 9;
     }
     records++;
   }

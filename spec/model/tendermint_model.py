@@ -203,12 +203,8 @@ def check_unlock_rule_necessity() -> bool:
     proposal) and the checker must find a violation — demonstrating the
     POL/lock rules are what carries safety, not the quorum size alone."""
     cfg = ModelConfig(n=4, byz=(3,), rounds=2)
-
-    class _NoLock(ModelConfig):
-        pass
-
-    # re-run exploration with the unlock guard removed by monkeypatching
-    # the lock check: emulate by treating every validator as never locked
+    # re-run the exploration with every validator treated as never
+    # locked (prevoting the proposal is always allowed)
     init = (tuple((None, -1) for _ in cfg.honest), frozenset(), frozenset())
     states = {init}
     byz_choices = (NIL, EQUIV)

@@ -42,6 +42,42 @@ class TestManifest:
 
 
 class TestProcessE2E:
+    def test_statesync_late_joiner(self, tmp_path):
+        """A fresh full node joins at height 7 via state sync: snapshot
+        discovery over p2p, trust hash fetched from the live network's
+        RPC (kvstore snapshots every 5 heights)."""
+        m = Manifest(
+            chain_id="e2e-statesync",
+            wait_height=9,
+            nodes=[
+                NodeManifest(name="v1"),
+                NodeManifest(name="v2"),
+                NodeManifest(name="v3"),
+                NodeManifest(
+                    name="joiner", mode="full", start_at=7, state_sync=True
+                ),
+            ],
+        )
+        net = Testnet(m, str(tmp_path))
+        net.setup()
+        try:
+            net.start()
+            net.wait_height(2)
+            net.start_late_joiners(timeout=180)
+            net.wait_height(m.wait_height, timeout=180)
+            inv = net.run_invariants()
+            assert inv["min_height"] >= m.wait_height
+            joiner = net.nodes[-1]
+            assert joiner.rpc.height() >= 7
+            # the joiner state-synced: its first stored block is past
+            # genesis (it never replayed 1..snapshot_height)
+            import e2e.rpc_client as rc
+
+            with pytest.raises(rc.RPCError):
+                joiner.rpc.block(1)
+        finally:
+            net.stop()
+
     def test_socket_abci_node(self, tmp_path):
         """One validator runs its kvstore app as a SEPARATE process over
         the socket ABCI flavor (reference: e2e abci_protocol=socket)."""
