@@ -5,11 +5,12 @@ Formulas are the unified/complete ones from RFC 8032 section 5.1.4 —
 complete for *all* curve points including the small-order points ZIP-215
 verification must handle, so every ladder step is branch-free.
 
-The double-base scalar multiplication s*B + m*A is a radix-16 Straus walk:
-64 digit positions, each 4 doublings plus one complete addition from a
-per-lane table {0..15}*A and one mixed (niels) addition from a 16-entry
-*constant* table {0..15}*B — the constant-table lookup is a small exact
-f32 matmul (one-hot x table) that rides the MXU instead of the VPU.
+The double-base scalar multiplication s*B + m*A is a signed radix-16
+Straus walk: 64 digit positions (digits in [-8,7]), each 4 doublings plus
+one complete addition from a per-lane table {0..8}*A (sign applied at
+select) and one mixed (niels) addition from a 9-entry *constant* table
+{0..8}*B — the constant-table lookup is a small exact f32 matmul
+(one-hot x table) that rides the MXU instead of the VPU.
 
 Reference behavior being reproduced: the double-base scalar multiplication
 inside curve25519-voi batch verification (crypto/ed25519/ed25519.go:189-222
@@ -35,7 +36,10 @@ BASE_X = ref.BASE[0]
 BASE_Y = ref.BASE[1]
 
 NPOS = 64  # radix-16 digit positions covering 256 scalar bits
-WINDOW = 16
+# Signed-digit window: digits in [-8, 7], tables hold {0..8}*P and the
+# ladder applies the sign at select time (halves table build + VMEM vs
+# the round-2 unsigned {0..15} tables).
+WINDOW = 9
 
 
 class PointBatch(NamedTuple):
@@ -148,10 +152,11 @@ def decompress(y: fe.F, sign: jnp.ndarray):
 
 @lru_cache(maxsize=1)
 def _niels_base_table() -> np.ndarray:
-    """(3*20, 16) f32: niels triples (y+x, y-x, 2dxy) of k*B, k = 0..15.
+    """(3*20, 9) f32: niels triples (y+x, y-x, 2dxy) of k*B, k = 0..8.
 
     Baked on host from the pure-python oracle; laid out for one exact f32
-    dot_general against a one-hot digit matrix."""
+    dot_general against a one-hot digit matrix.  Negative digits reuse
+    entry |k|: niels negation swaps (y+x, y-x) and negates 2dxy."""
     out = np.zeros((3, fe.NLIMBS, WINDOW), np.float32)
     P = fe.P_INT
     for k in range(WINDOW):
@@ -168,12 +173,15 @@ def _niels_base_table() -> np.ndarray:
 
 
 def select_base(digit: jnp.ndarray, tbl: jnp.ndarray | None = None):
-    """digit (B,) in [0,16) -> niels triple of digit*B via exact f32 matmul
-    (constant table is the shared operand -> MXU, not VPU).
+    """digit (B,) in [-8, 8] -> niels triple of digit*B via exact f32
+    matmul over |digit| (constant table is the shared operand -> MXU, not
+    VPU) with the sign applied on the VPU: swap (y+x, y-x), negate 2dxy.
 
     ``tbl`` lets a Pallas caller pass the table as a kernel input (Pallas
     rejects closure-captured array constants); defaults to the baked one."""
-    onehot = digit[None, :] == lax.broadcasted_iota(
+    neg = digit < 0
+    mag = jnp.abs(digit)
+    onehot = mag[None, :] == lax.broadcasted_iota(
         jnp.int32, (WINDOW, digit.shape[0]), 0
     )
     if tbl is None:
@@ -191,12 +199,17 @@ def select_base(digit: jnp.ndarray, tbl: jnp.ndarray | None = None):
     sel = sel.astype(jnp.int32)
     n = fe.NLIMBS
     mk = lambda i: fe.F(sel[i * n : (i + 1) * n], 0, fe.MASK)
-    return mk(0), mk(1), mk(2)
+    ypx0, ymx0, t2d0 = mk(0), mk(1), mk(2)
+    ypx = fe.select(neg, ymx0, ypx0)
+    ymx = fe.select(neg, ypx0, ymx0)
+    sgn = 1 - 2 * neg.astype(jnp.int32)
+    return ypx, ymx, fe.mul_sign(t2d0, sgn)
 
 
 def build_table_a(a: PointBatch):
-    """Per-lane table {0..15}*A as stacked arrays (16, 20, B) per coord,
-    with T pre-scaled by 2d."""
+    """Per-lane table {0..8}*A as stacked arrays (9, 20, B) per coord,
+    with T pre-scaled by 2d (signed digits supply {-8..-1} by sign flip
+    at select time)."""
     batch = a.x.v.shape[1]
     entries = [identity(batch), a]
     for _ in range(2, WINDOW):
@@ -215,20 +228,24 @@ def build_table_a(a: PointBatch):
 
 
 def select_table_a(table, digit: jnp.ndarray) -> TablePoint:
-    """Branch-free per-lane 16-way select: one-hot weighted sum on the VPU
-    (the table differs per lane, so there is no shared operand for the
-    MXU).  Values stay int32 exact."""
+    """Branch-free per-lane 9-way select over |digit| with the sign
+    applied to X and T2d (extended-point negation): one-hot weighted sum
+    on the VPU (the table differs per lane, so there is no shared operand
+    for the MXU).  Values stay int32 exact."""
+    mag = jnp.abs(digit)
     onehot = (
-        digit[None, :]
+        mag[None, :]
         == lax.broadcasted_iota(jnp.int32, (WINDOW, digit.shape[0]), 0)
-    ).astype(jnp.int32)  # (16, B)
+    ).astype(jnp.int32)  # (9, B)
     outs = []
-    for c in table:  # (16, 20, B)
+    for c in table:  # (9, 20, B)
         acc = c[0] * onehot[0][None, :]
         for k in range(1, WINDOW):
             acc = acc + c[k] * onehot[k][None, :]
         outs.append(fe.F(acc, fe.RED_LO, fe.RED_HI))
-    return TablePoint(*outs)
+    sgn = 1 - 2 * (digit < 0).astype(jnp.int32)
+    x, y, z, t2d = outs
+    return TablePoint(fe.mul_sign(x, sgn), y, z, fe.mul_sign(t2d, sgn))
 
 
 # ---------------------------------------------------------------------------
@@ -243,12 +260,14 @@ def double_base_scalar_mul(
     dig_get=None,
     batch: int | None = None,
 ) -> PointBatch:
-    """Compute s*B + m*A jointly (radix-16 Straus).
+    """Compute s*B + m*A jointly (signed radix-16 Straus).
 
-    dig_s, dig_m: (64, B) int32 digits in [0,16), most significant first.
-    Per position: 4 doublings, one complete add of {0..15}*A (per-lane
-    table), one niels add of {0..15}*B (constant table; pass ``niels_tbl``
-    explicitly from inside a Pallas kernel).
+    dig_s, dig_m: (64, B) int32 signed digits in [-8,7], most significant
+    first (fe.signed_digits_msb_first).
+    Per position: 4 doublings, one complete add of ±{0..8}*A (9-entry
+    per-lane table, sign at select), one niels add of ±{0..8}*B (9-entry
+    constant table; pass ``niels_tbl`` explicitly from inside a Pallas
+    kernel).
 
     ``dig_get``: optional ``i -> (ds, dm)`` provider overriding the array
     arguments — a Pallas kernel passes a closure reading its digit *refs*

@@ -573,3 +573,28 @@ def nibbles_msb_first(b: jnp.ndarray) -> jnp.ndarray:
         byte = x[:, k >> 1]
         digs.append((byte >> (4 * (k & 1))) & 0xF)
     return jnp.stack(digs)  # (64, B), row 0 = most significant
+
+
+def signed_digits_msb_first(b: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 little-endian scalar -> (64, B) int32 SIGNED radix-16
+    digits in [-8, 7], most-significant first.
+
+    Recoding d'_k = d_k + c_in - 16*c_out (carry when the digit would
+    exceed 7) keeps the value identical while the ladder's per-lane table
+    shrinks to {0..8}*A — negation is a sign flip on X and T, so the
+    recode halves table build cost and table VMEM.  Scalars here are
+    < L < 2^253 (mod-L reduced on the host), so the top nibble is <= 1
+    and the final carry is always absorbed."""
+    x = b.astype(jnp.int32)
+    digs = []
+    c = jnp.zeros_like(x[:, 0])
+    for k in range(64):  # LSB-first recode, carry rippling upward
+        d = ((x[:, k >> 1] >> (4 * (k & 1))) & 0xF) + c
+        c = (d >= 8).astype(jnp.int32)
+        digs.append(d - (c << 4))
+    return jnp.stack(digs[::-1])  # (64, B), row 0 = most significant
+
+
+def mul_sign(a: F, sgn: jnp.ndarray) -> F:
+    """Multiply by a per-lane sign in {-1, +1} ((B,) int32)."""
+    return F(a.v * sgn[None, :], min(a.lo, -a.hi), max(a.hi, -a.lo))

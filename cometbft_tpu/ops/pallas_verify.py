@@ -3,8 +3,8 @@
 The XLA path (``ops.verify.verify_core``) streams every intermediate of the
 ~3k field multiplications through HBM; this kernel tiles the signature batch
 over the lane dimension and keeps the whole working set — decompressed
-points, the 16-entry per-lane table, and every ladder intermediate — in
-VMEM for the full 64-position Straus walk.  The field/point layers are the
+points, the 9-entry signed-digit per-lane table, and every ladder
+intermediate — in VMEM for the full 64-position Straus walk.  The field/point layers are the
 *same* traced functions as the XLA path (``ops.fe25519`` /
 ``ops.ed25519_point``): they are written reshape-free and 2-D-safe exactly
 so one implementation serves both, and the differential oracle tests cover
@@ -127,8 +127,8 @@ def verify_core_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok,
         s_ok = jnp.concatenate([s_ok, jnp.zeros((pad,), s_ok.dtype)])
     ya, sa = fe.unpack255(a_bytes)
     yr, sr = fe.unpack255(r_bytes)
-    dig_s = fe.nibbles_msb_first(s_bytes)
-    dig_m = fe.nibbles_msb_first(m_bytes)
+    dig_s = fe.signed_digits_msb_first(s_bytes)
+    dig_m = fe.signed_digits_msb_first(m_bytes)
     out = _build(batch + pad, tile)(
         ya.v,
         sa[None, :].astype(jnp.int32),

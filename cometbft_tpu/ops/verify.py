@@ -54,8 +54,8 @@ def verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
     yr, sr = fe.unpack255(r_bytes)
     ok_a, a = ep.decompress(ya, sa)
     ok_r, r = ep.decompress(yr, sr)
-    dig_s = fe.nibbles_msb_first(s_bytes)
-    dig_m = fe.nibbles_msb_first(m_bytes)
+    dig_s = fe.signed_digits_msb_first(s_bytes)
+    dig_m = fe.signed_digits_msb_first(m_bytes)
     p = ep.double_base_scalar_mul(dig_s, dig_m, a)
     q = ep.add(p, ep.negate(r))
     # Cofactored equation: [8](s*B + m*A - R) == identity (ZIP-215).

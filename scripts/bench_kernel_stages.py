@@ -81,8 +81,8 @@ def make_stage_kernel(stage: str):
     def run(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
         ya, sa = fe.unpack255(a_bytes)
         yr, sr = fe.unpack255(r_bytes)
-        dig_s = fe.nibbles_msb_first(s_bytes)
-        dig_m = fe.nibbles_msb_first(m_bytes)
+        dig_s = fe.signed_digits_msb_first(s_bytes)
+        dig_m = fe.signed_digits_msb_first(m_bytes)
         return call(
             ya.v, sa[None, :].astype(jnp.int32), yr.v,
             sr[None, :].astype(jnp.int32), dig_s, dig_m,

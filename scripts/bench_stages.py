@@ -50,7 +50,7 @@ def main():
     def stage_unpack(a_bytes, r_bytes, s_bytes, m_bytes):
         ya, sa = fe.unpack255(a_bytes)
         yr, sr = fe.unpack255(r_bytes)
-        return ya.v, sa, yr.v, sr, fe.nibbles_msb_first(s_bytes), fe.nibbles_msb_first(m_bytes)
+        return ya.v, sa, yr.v, sr, fe.signed_digits_msb_first(s_bytes), fe.signed_digits_msb_first(m_bytes)
 
     @jax.jit
     def stage_decompress(a_bytes):
@@ -69,7 +69,7 @@ def main():
         ya, sa = fe.unpack255(a_bytes)
         _, a = ep.decompress(ya, sa)
         p = ep.double_base_scalar_mul(
-            fe.nibbles_msb_first(s_bytes), fe.nibbles_msb_first(m_bytes), a
+            fe.signed_digits_msb_first(s_bytes), fe.signed_digits_msb_first(m_bytes), a
         )
         return p.x.v, p.y.v, p.z.v
 

@@ -114,7 +114,7 @@ def test_double_base_scalar_mul_matches_oracle():
                 for v in vals
             ]
         )
-        return fe.nibbles_msb_first(jnp.asarray(arr))
+        return fe.signed_digits_msb_first(jnp.asarray(arr))
 
     got = _batch_to_affine(
         ep.double_base_scalar_mul(enc(svals), enc(mvals), pb)
