@@ -565,8 +565,12 @@ def unpack255(b: jnp.ndarray):
 
 
 def nibbles_msb_first(b: jnp.ndarray) -> jnp.ndarray:
-    """(B, 32) uint8 little-endian scalar -> (64, B) int32 radix-16 digits,
-    most-significant digit first (processing order of the ladder)."""
+    """(B, 32) uint8 little-endian scalar -> (64, B) int32 UNSIGNED
+    radix-16 digits in [0,15], most-significant first.
+
+    TEST ORACLE ONLY: the ladder and its 9-entry tables consume SIGNED
+    digits (``signed_digits_msb_first``); unsigned digits 9..15 match no
+    table entry and silently select the zero point."""
     x = b.astype(jnp.int32)
     digs = []
     for k in reversed(range(64)):  # k = nibble index, LSB-first storage

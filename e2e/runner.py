@@ -377,19 +377,22 @@ class Testnet:
 
         # header/app-hash agreement at every sampled height
         ref_rpc = up[0].rpc
+        earliest = {
+            n.manifest.name: int(
+                n.rpc.status()["sync_info"]["earliest_block_height"]
+            )
+            for n in up
+            if n.manifest.state_sync
+        }
         for sample in {2, max(2, h // 2), h}:
             ref_blk = ref_rpc.block(sample)
             want = ref_blk["block_id"]["hash"]
             want_app = ref_blk["block"]["header"]["app_hash"]
             for n in up[1:]:
-                if n.manifest.state_sync:
-                    # heights below the snapshot are legitimately absent
-                    # on a state-synced node; anything else must compare
-                    earliest = int(
-                        n.rpc.status()["sync_info"]["earliest_block_height"]
-                    )
-                    if sample < earliest:
-                        continue
+                # heights below the snapshot are legitimately absent on a
+                # state-synced node; anything else must compare
+                if sample < earliest.get(n.manifest.name, 0):
+                    continue
                 blk = n.rpc.block(sample)
                 assert blk["block_id"]["hash"] == want, (
                     f"fork at {sample}: {n.manifest.name}"

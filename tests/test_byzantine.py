@@ -1,0 +1,109 @@
+"""Live byzantine behavior over a real multi-node network.
+
+Reference model: internal/consensus/byzantine_test.go — a validator
+equivocates (signs two conflicting precommits for one height/round); the
+honest nodes' vote sets detect the conflict, synthesize
+DuplicateVoteEvidence via the consensus -> evidence-pool path
+(state.py report_conflicting_votes; reference state.go addVote ->
+ErrVoteConflictingVotes), gossip it, and commit it in a block so the
+application sees the misbehavior.
+"""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.types.basic import PRECOMMIT_TYPE, BlockID, PartSetHeader
+from cometbft_tpu.types.vote import Vote
+
+from tests.test_reactors import CHAIN_ID, _wait_for, net  # noqa: F401
+
+
+class TestLiveEquivocation:
+    def test_conflicting_vote_becomes_committed_evidence(self, net):  # noqa: F811
+        """Inject a CONFLICTING VOTE (not pre-built evidence) into a
+        peer's consensus input; the vote-set conflict detector must
+        produce the evidence and the chain must commit it."""
+        # wait until the chain is moving
+        assert _wait_for(lambda: net[0].consensus.height >= 2, timeout=60)
+
+        byz_priv = Ed25519PrivKey.from_seed(
+            hashlib.sha256(b"reactval0").digest()
+        )
+        addr = byz_priv.pub_key().address()
+        target = net[1]
+
+        # validator set is constant in this network
+        vals = target.state_store.load_validators(1)
+        idx, val = vals.get_by_address(addr)
+
+        # Equivocate at LIVE heights: the conflict detector only fires
+        # for the node's current height (state.py _is_our_height_vote;
+        # reference state.go addVote), so inject a conflicting precommit
+        # for (current height, round 0) of every node — the byzantine
+        # validator's real precommit for the decided block collides with
+        # it inside the VoteSet.  Repeat over a few heights until some
+        # node detects (timing-dependent which height lands).
+        from cometbft_tpu.consensus.messages import VoteMessage
+        from cometbft_tpu.types.basic import Timestamp
+        import time as _time
+
+        def inject_all_current():
+            for n in net:
+                h = n.consensus.height
+                fake = Vote(
+                    type_=PRECOMMIT_TYPE,
+                    height=h,
+                    round_=0,
+                    block_id=BlockID(
+                        hash=hashlib.sha256(b"equiv-%d" % h).digest(),
+                        part_set_header=PartSetHeader(
+                            1, hashlib.sha256(b"equiv-p-%d" % h).digest()
+                        ),
+                    ),
+                    timestamp=Timestamp.now(),
+                    validator_address=addr,
+                    validator_index=idx,
+                )
+                fake.signature = byz_priv.sign(fake.sign_bytes(CHAIN_ID))
+                n.consensus.add_peer_message(
+                    VoteMessage(vote=fake), "byz-peer"
+                )
+
+        for _ in range(6):
+            inject_all_current()
+            _time.sleep(0.5)
+
+        # conflict detection -> evidence pool (on at least one node),
+        # then gossip to all, then committed into a block
+        def evidence_committed(n):
+            for height in range(1, n.block_store.height() + 1):
+                block = n.block_store.load_block(height)
+                if block and any(
+                    getattr(e, "vote_a", None) is not None
+                    and e.vote_a.validator_address == addr
+                    for e in block.evidence
+                ):
+                    return True
+            return False
+
+        def pool_or_committed(n):
+            pend = list(n.evidence_pool.all_pending())
+            if any(
+                ev.vote_a.validator_address == addr
+                for ev in pend
+                if hasattr(ev, "vote_a")
+            ):
+                return True
+            return evidence_committed(n)
+
+        assert _wait_for(
+            lambda: all(pool_or_committed(n) for n in net), timeout=60
+        ), "equivocation never became evidence on every node"
+
+        assert _wait_for(
+            lambda: any(evidence_committed(n) for n in net), timeout=60
+        ), (
+            "evidence gossiped but never committed in a block"
+        )
