@@ -420,6 +420,7 @@ class ConsensusState(BaseService):
                     self.state,
                     last_commit,
                     self._priv_addr,
+                    last_ext_commit_info=self._last_ext_commit_info(height),
                 )
             except Exception as e:  # noqa: BLE001
                 self.logger.error("failed to create proposal block", err=repr(e))
@@ -448,6 +449,45 @@ class ConsensusState(BaseService):
         self.logger.info(
             "signed proposal", height=height, round=round_, hash=block_id.hash
         )
+
+    def _last_ext_commit_info(self, height: int):
+        """The previous height's precommit extensions as the app-facing
+        ExtendedCommitInfo for PrepareProposal (reference: state.go
+        defaultDecideProposal -> LoadBlockExtendedCommit ->
+        ToExtendedCommitInfo), or None when extensions were not enabled."""
+        from cometbft_tpu.abci import types as at
+
+        if height <= self.state.initial_height or not self._extensions_enabled(
+            height - 1
+        ):
+            return None
+        ec = None
+        if (
+            self.rs.last_commit is not None
+            and self.rs.last_commit.has_two_thirds_majority()
+        ):
+            ec = self.rs.last_commit.make_extended_commit()
+        else:
+            ec = self.block_store.load_extended_commit(height - 1)
+        if ec is None:
+            return None
+        vals = self.state.last_validators
+        votes = []
+        for i, cs in enumerate(ec.extended_signatures):
+            val = vals.validators[i] if vals and i < len(vals.validators) else None
+            votes.append(
+                at.ExtendedVoteInfo(
+                    validator=at.Validator(
+                        address=cs.validator_address
+                        or (val.address if val else b""),
+                        power=val.voting_power if val else 0,
+                    ),
+                    vote_extension=cs.extension,
+                    extension_signature=cs.extension_signature,
+                    block_id_flag=cs.block_id_flag,
+                )
+            )
+        return at.ExtendedCommitInfo(round_=ec.round_, votes=votes)
 
     def _load_last_commit(self, height: int) -> Optional[Commit]:
         from cometbft_tpu.types.block import empty_commit
@@ -677,8 +717,16 @@ class ConsensusState(BaseService):
         fail_point(10)
         # save block + seen commit (DISK)
         if self.block_store.height() < height:
-            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self.block_store.save_block(block, parts, seen_commit)
+            precommits = rs.votes.precommits(rs.commit_round)
+            seen_commit = precommits.make_commit()
+            ext_commit = (
+                precommits.make_extended_commit()
+                if self._extensions_enabled(height)
+                else None
+            )
+            self.block_store.save_block(
+                block, parts, seen_commit, extended_commit=ext_commit
+            )
 
         fail_point(11)
         # WAL end-height marker (DISK fsync) — replay boundary
@@ -887,6 +935,13 @@ class ConsensusState(BaseService):
             and rs.step == STEP_NEW_HEIGHT
             and rs.last_commit is not None
         ):
+            # late votes feed rs.last_commit -> make_extended_commit ->
+            # the app's ExtendedCommitInfo, so their extensions need the
+            # same verification as current-height precommits
+            if not self._check_vote_extension(
+                vote, self.state.last_validators
+            ):
+                return
             if rs.last_commit.add_vote(vote):
                 if self.event_bus:
                     self.event_bus.publish_vote(EventDataVote(vote))
@@ -899,6 +954,9 @@ class ConsensusState(BaseService):
 
         if vote.height != rs.height:
             return  # ignore other-height votes
+
+        if not self._check_vote_extension(vote, rs.validators):
+            return
 
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
@@ -915,6 +973,55 @@ class ConsensusState(BaseService):
             self._check_prevotes(vote)
         else:
             self._check_precommits(vote)
+
+    def _check_vote_extension(self, vote: Vote, vals) -> bool:
+        """Gate a received vote on the extension rules (reference:
+        state.go:2296 addVote -> VerifyExtension +
+        blockExec.VerifyVoteExtension):
+
+          * extensions disabled at the vote's height: no extension bytes
+            may appear at all;
+          * enabled: prevotes and nil precommits must carry none, and a
+            non-nil precommit from another validator must have a valid
+            extension signature and pass the app's VerifyVoteExtension.
+        """
+        enabled = self._extensions_enabled(vote.height)
+        has_ext = bool(vote.extension or vote.extension_signature)
+        if not enabled or vote.type_ != PRECOMMIT_TYPE or vote.is_nil():
+            return not has_ext
+        if vote.validator_address == self._priv_addr:
+            return True
+        return self._verify_vote_extension(vote, vals)
+
+    def _verify_vote_extension(self, vote: Vote, vals) -> bool:
+        val = (
+            vals.get_by_address(vote.validator_address)
+            if vals is not None
+            else None
+        )
+        if val is None or val[1] is None:
+            return False
+        pub = val[1].pub_key
+        if not vote.extension_signature or not pub.verify_signature(
+            vote.extension_sign_bytes(self.state.chain_id),
+            vote.extension_signature,
+        ):
+            self.logger.debug(
+                "rejecting precommit: bad extension signature",
+                val=vote.validator_address.hex(),
+            )
+            return False
+        try:
+            if not self.block_exec.verify_vote_extension(vote):
+                self.logger.debug(
+                    "rejecting precommit: app rejected extension",
+                    val=vote.validator_address.hex(),
+                )
+                return False
+        except Exception as e:  # noqa: BLE001
+            self.logger.error("verify_vote_extension failed", err=repr(e))
+            return False
+        return True
 
     def _check_prevotes(self, vote: Vote) -> None:
         rs = self.rs

@@ -111,8 +111,16 @@ class BlockStore:
 
     # -- writes -----------------------------------------------------------
 
-    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit):
-        """Reference: store/store.go:586 SaveBlock."""
+    def save_block(
+        self,
+        block: Block,
+        part_set: PartSet,
+        seen_commit: Commit,
+        extended_commit=None,
+    ):
+        """Reference: store/store.go:586 SaveBlock / SaveBlockWithExtendedCommit
+        — ``extended_commit`` is stored when vote extensions are enabled so
+        a restarting proposer can rebuild the app's ExtendedCommitInfo."""
         height = block.header.height
         with self._lock:
             if self._height > 0 and height != self._height + 1:
@@ -136,6 +144,13 @@ class BlockStore:
                 (_k_commit(height - 1), codec.encode_commit(block.last_commit))
             )
             sets.append((_k_seen_commit(height), codec.encode_commit(seen_commit)))
+            if extended_commit is not None:
+                sets.append(
+                    (
+                        _k_ext_commit(height),
+                        codec.encode_extended_commit(extended_commit),
+                    )
+                )
             self._db.write_batch(sets, [])
             if self._base == 0:
                 self._base = height
@@ -213,6 +228,11 @@ class BlockStore:
         raw = self._db.get(_k_seen_commit(height))
         return codec.decode_commit(raw) if raw else None
 
+    def load_extended_commit(self, height: int):
+        """Reference: store.go LoadBlockExtendedCommit."""
+        raw = self._db.get(_k_ext_commit(height))
+        return codec.decode_extended_commit(raw) if raw else None
+
     def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
         with self._lock:
             lo, hi = self._base, self._height
@@ -234,7 +254,12 @@ class BlockStore:
             # keep _k_commit(h-1): it certifies the block that REMAINS the
             # head (reference: store/store.go DeleteLatestBlock deletes the
             # commit key at the target height only)
-            deletes = [_k_meta(h), _k_commit(h), _k_seen_commit(h)]
+            deletes = [
+                _k_meta(h),
+                _k_commit(h),
+                _k_seen_commit(h),
+                _k_ext_commit(h),
+            ]
             meta = self.load_block_meta(h)
             if meta:
                 for i in range(meta.block_id.part_set_header.total):
@@ -257,7 +282,12 @@ class BlockStore:
                 if meta:
                     for i in range(meta.block_id.part_set_header.total):
                         deletes.append(_k_part(h, i))
-                deletes += [_k_meta(h), _k_commit(h - 1), _k_seen_commit(h)]
+                deletes += [
+                    _k_meta(h),
+                    _k_commit(h - 1),
+                    _k_seen_commit(h),
+                    _k_ext_commit(h),
+                ]
                 pruned += 1
             self._db.write_batch([], deletes)
             self._base = retain_height

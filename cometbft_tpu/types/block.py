@@ -96,6 +96,27 @@ class Data:
 
 
 @dataclass
+class ExtendedCommit:
+    """A commit whose signatures carry the precommits' vote extensions
+    (reference: types/block.go ExtendedCommit).  Persisted by the block
+    store when extensions are enabled so a restarting proposer can still
+    hand the app its ExtendedCommitInfo."""
+
+    height: int
+    round_: int
+    block_id: "BlockID"
+    extended_signatures: list
+
+    def to_commit(self) -> "Commit":
+        return Commit(
+            height=self.height,
+            round_=self.round_,
+            block_id=self.block_id,
+            signatures=[s.to_commit_sig() for s in self.extended_signatures],
+        )
+
+
+@dataclass
 class Commit:
     height: int
     round_: int

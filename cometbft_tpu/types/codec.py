@@ -135,6 +135,53 @@ def decode_commit(body: bytes) -> Commit:
     )
 
 
+def encode_extended_commit_sig(cs) -> bytes:
+    """Reference wire shape: cometbft.types.v1.ExtendedCommitSig."""
+    return encode_commit_sig(cs) + pe.t_bytes(5, cs.extension) + pe.t_bytes(
+        6, cs.extension_signature
+    )
+
+
+def decode_extended_commit_sig(body: bytes):
+    from cometbft_tpu.types.vote import ExtendedCommitSig
+
+    base = decode_commit_sig(body)
+    f = pe.fields_dict(body)
+    return ExtendedCommitSig(
+        block_id_flag=base.block_id_flag,
+        validator_address=base.validator_address,
+        timestamp=base.timestamp,
+        signature=base.signature,
+        extension=bytes(f.get(5, [b""])[-1]),
+        extension_signature=bytes(f.get(6, [b""])[-1]),
+    )
+
+
+def encode_extended_commit(c) -> bytes:
+    out = [
+        pe.t_varint(1, c.height),
+        pe.t_varint(2, c.round_),
+        pe.t_message(3, c.block_id.encode(), always=True),
+    ]
+    for cs in c.extended_signatures:
+        out.append(pe.t_message(4, encode_extended_commit_sig(cs), always=True))
+    return b"".join(out)
+
+
+def decode_extended_commit(body: bytes):
+    from cometbft_tpu.types.block import ExtendedCommit
+
+    f = pe.fields_dict(body)
+    return ExtendedCommit(
+        height=pe.to_int64(f.get(1, [0])[-1]),
+        round_=f.get(2, [0])[-1],
+        block_id=decode_block_id(f[3][-1]) if 3 in f else BlockID(),
+        extended_signatures=[
+            decode_extended_commit_sig(b) for b in f.get(4, [])
+        ],
+    )
+
+
 # -- data / block -----------------------------------------------------------
 
 def encode_data(d: Data) -> bytes:

@@ -116,6 +116,39 @@ class CommitSig:
 
 
 @dataclass
+class ExtendedCommitSig(CommitSig):
+    """CommitSig + the precommit's vote extension (reference:
+    types/block.go ExtendedCommitSig)."""
+
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    @staticmethod
+    def absent_ext_sig() -> "ExtendedCommitSig":
+        return ExtendedCommitSig(BLOCK_ID_FLAG_ABSENT)
+
+    @staticmethod
+    def from_extended_vote(vote: Vote) -> "ExtendedCommitSig":
+        flag = BLOCK_ID_FLAG_NIL if vote.is_nil() else BLOCK_ID_FLAG_COMMIT
+        return ExtendedCommitSig(
+            block_id_flag=flag,
+            validator_address=vote.validator_address,
+            timestamp=vote.timestamp,
+            signature=vote.signature,
+            extension=vote.extension,
+            extension_signature=vote.extension_signature,
+        )
+
+    def to_commit_sig(self) -> CommitSig:
+        return CommitSig(
+            block_id_flag=self.block_id_flag,
+            validator_address=self.validator_address,
+            timestamp=self.timestamp,
+            signature=self.signature,
+        )
+
+
+@dataclass
 class Proposal:
     height: int
     round_: int

@@ -205,3 +205,27 @@ class VoteSet:
             block_id=self.maj23,
             signatures=sigs,
         )
+
+    def make_extended_commit(self) -> "ExtendedCommit":
+        """Like ``make_commit`` but retaining each precommit's vote
+        extension (reference: vote_set.go MakeExtendedCommit)."""
+        from cometbft_tpu.types.block import ExtendedCommit
+        from cometbft_tpu.types.vote import ExtendedCommitSig
+
+        if self.maj23 is None or self.maj23.is_zero():
+            raise VoteError("cannot make commit: no 2/3 majority for a block")
+        sigs = []
+        for vote in self.votes:
+            if vote is None:
+                sigs.append(ExtendedCommitSig.absent_ext_sig())
+                continue
+            cs = ExtendedCommitSig.from_extended_vote(vote)
+            if cs.for_block() and vote.block_id != self.maj23:
+                cs = ExtendedCommitSig.absent_ext_sig()
+            sigs.append(cs)
+        return ExtendedCommit(
+            height=self.height,
+            round_=self.round_,
+            block_id=self.maj23,
+            extended_signatures=sigs,
+        )
