@@ -414,13 +414,31 @@ class ConsensusState(BaseService):
             if last_commit is None:
                 self.logger.error("no last commit, cannot propose", height=height)
                 return
+            ext_info = self._last_ext_commit_info(height)
+            if (
+                ext_info is None
+                and height > self.state.initial_height
+                and self._extensions_enabled(height - 1)
+            ):
+                # no extended commit available (e.g. the node blocksynced
+                # to the head and never collected last-height precommits):
+                # proposing with an empty ExtendedCommitInfo would hand the
+                # app zero votes where the contract promises +2/3 — refuse
+                # and let another validator propose (reference state.go
+                # panics here; we fail just this proposal)
+                self.logger.error(
+                    "cannot propose: vote extensions enabled but no "
+                    "extended commit for the previous height",
+                    height=height,
+                )
+                return
             try:
                 block = self.block_exec.create_proposal_block(
                     height,
                     self.state,
                     last_commit,
                     self._priv_addr,
-                    last_ext_commit_info=self._last_ext_commit_info(height),
+                    last_ext_commit_info=ext_info,
                 )
             except Exception as e:  # noqa: BLE001
                 self.logger.error("failed to create proposal block", err=repr(e))

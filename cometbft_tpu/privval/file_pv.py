@@ -183,12 +183,26 @@ class FilePV:
         step = _VOTE_STEP[vote.type_]
         same = self._state.check_hrs(vote.height, vote.round_, step)
         sb = vote.sign_bytes(chain_id)
+
+        def sign_ext() -> None:
+            # The extension signature is deterministic over the canonical
+            # extension sign bytes and carries no double-sign risk of its
+            # own, so it is (re)signed on EVERY path — including the
+            # idempotent re-sign after a restart, where skipping it would
+            # emit a precommit whose extension peers reject (reference
+            # privval signs extensions unconditionally).
+            if sign_extension and vote.type_ == PRECOMMIT_TYPE and not vote.is_nil():
+                vote.extension_signature = self.priv_key.sign(
+                    vote.extension_sign_bytes(chain_id)
+                )
+
         if same:
             # Idempotent re-sign: identical sign bytes -> return saved sig;
             # timestamp-only difference -> same vote regenerated after a
             # restart: return the saved signature (and timestamp).
             if sb == self._state.sign_bytes:
                 vote.signature = self._state.signature
+                sign_ext()
                 return
             new_body, _ = _strip_timestamp(sb)
             old_body, old_ts = _strip_timestamp(self._state.sign_bytes)
@@ -198,6 +212,7 @@ class FilePV:
                 if old_ts:
                     vote.timestamp = codec.decode_timestamp(old_ts)
                 vote.signature = self._state.signature
+                sign_ext()
                 return
             raise DoubleSignError(
                 f"conflicting vote data at height {vote.height} round {vote.round_}"
@@ -212,10 +227,7 @@ class FilePV:
         )
         self._save_state()  # persist BEFORE releasing the signature
         vote.signature = sig
-        if sign_extension and vote.type_ == PRECOMMIT_TYPE and not vote.is_nil():
-            vote.extension_signature = self.priv_key.sign(
-                vote.extension_sign_bytes(chain_id)
-            )
+        sign_ext()
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         same = self._state.check_hrs(

@@ -198,7 +198,26 @@ class Testnet:
                 cwd=REPO,
             )
         node.app_laddr = addr
-        time.sleep(1.0)  # let the app bind before the node dials
+        # wait until the app actually listens — the subprocess pays a
+        # multi-second interpreter+jax import before binding, longer on a
+        # loaded machine (a fixed sleep here was a flake source)
+        hostport = addr.split("://", 1)[-1]
+        host, _, port = hostport.rpartition(":")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if node.app_proc.poll() is not None:
+                raise RuntimeError(
+                    f"{node.manifest.name}: ABCI app process exited "
+                    f"rc={node.app_proc.returncode}"
+                )
+            try:
+                socket.create_connection((host, int(port)), timeout=1).close()
+                return
+            except OSError:
+                time.sleep(0.2)
+        raise TimeoutError(
+            f"{node.manifest.name}: ABCI app never listened on {addr}"
+        )
 
     def start_node(self, node: RunningNode) -> None:
         self._maybe_start_app(node)

@@ -187,6 +187,45 @@ def test_wal_rotation(tmp_path):
     wal.close()
 
 
+def test_file_pv_resign_after_restart_signs_extension(tmp_path):
+    """The idempotent re-sign path (same vote regenerated after a
+    restart) must still sign the vote EXTENSION — a restart otherwise
+    emits a precommit whose extension peers reject (round-3 review
+    finding; reference privval signs extensions unconditionally)."""
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp)
+    bid = BlockID(
+        hash=hashlib.sha256(b"eb").digest(),
+        part_set_header=PartSetHeader(1, hashlib.sha256(b"ep").digest()),
+    )
+
+    def mkvote():
+        return Vote(
+            type_=PRECOMMIT_TYPE,
+            height=9,
+            round_=0,
+            block_id=bid,
+            timestamp=Timestamp(1700000100, 0),
+            validator_address=pv.pub_key().address(),
+            validator_index=0,
+            extension=b"ext-data",
+        )
+
+    v1 = mkvote()
+    pv.sign_vote(CHAIN_ID, v1, sign_extension=True)
+    assert v1.extension_signature
+
+    # "restart": reload the same key/state files, re-sign the same vote
+    pv2 = FilePV.load_or_generate(kp, sp)
+    v2 = mkvote()
+    pv2.sign_vote(CHAIN_ID, v2, sign_extension=True)
+    assert v2.signature == v1.signature  # idempotent main signature
+    assert v2.extension_signature, "extension unsigned on the re-sign path"
+    assert pv.pub_key().verify_signature(
+        v2.extension_sign_bytes(CHAIN_ID), v2.extension_signature
+    )
+
+
 def test_file_pv_double_sign_protection(tmp_path):
     kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
     pv = FilePV.generate(kp, sp)

@@ -108,8 +108,9 @@ def test_extensions_flow_into_next_proposal(tmp_path):
         )
         assert v.extension_signature, "extension not signed"
 
-    # extended commits are persisted (blocksync serves them to catching-up
-    # peers when extensions are enabled)
+    # extended commits are persisted (a restarting proposer reloads them;
+    # nodes lacking one refuse to propose rather than hand the app an
+    # empty ExtendedCommitInfo)
     ec = node.block_store.load_extended_commit(2)
     assert ec is not None
 
