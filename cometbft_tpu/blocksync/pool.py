@@ -38,6 +38,7 @@ class _Request:
     peer_id: str
     sent_at: float
     block: Optional[object] = None  # types.Block once received
+    ext_commit: Optional[object] = None  # types.ExtendedCommit when served
 
 
 class BlockPool:
@@ -92,15 +93,17 @@ class BlockPool:
 
     # -- blocks ------------------------------------------------------------
 
-    def add_block(self, peer_id: str, block) -> bool:
+    def add_block(self, peer_id: str, block, ext_commit=None) -> bool:
         """Reference: pool.go:296 AddBlock — only accepted if this peer owns
-        the outstanding request for that height."""
+        the outstanding request for that height.  ``ext_commit`` rides
+        along when the serving peer stored one (vote extensions)."""
         height = block.header.height
         with self._lock:
             req = self.requests.get(height)
             if req is None or req.peer_id != peer_id or req.block is not None:
                 return False
             req.block = block
+            req.ext_commit = ext_commit
             pd = self.peers.get(peer_id)
             if pd is not None:
                 pd.num_pending = max(pd.num_pending - 1, 0)
@@ -126,6 +129,7 @@ class BlockPool:
                 second.block if second else None,
                 first.peer_id if first else "",
                 second.peer_id if second else "",
+                first.ext_commit if first else None,
             )
 
     def pop_request(self) -> None:

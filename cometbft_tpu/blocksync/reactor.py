@@ -133,12 +133,17 @@ class BlocksyncReactor(Reactor):
             height = pe.to_int64(f.get(1, [0])[-1])
             block = self.block_store.load_block(height)
             if block is not None:
+                body = pe.t_message(1, codec.encode_block(block), always=True)
+                # attach the extended commit when stored (vote extensions):
+                # a catching-up validator needs it to propose (reference:
+                # BlockResponse.ext_commit)
+                ec = self.block_store.load_extended_commit(height)
+                if ec is not None:
+                    body += pe.t_message(
+                        2, codec.encode_extended_commit(ec), always=True
+                    )
                 peer.try_send(
-                    BLOCKSYNC_CHANNEL,
-                    _enc(
-                        _MSG_BLOCK_RESPONSE,
-                        pe.t_message(1, codec.encode_block(block), always=True),
-                    ),
+                    BLOCKSYNC_CHANNEL, _enc(_MSG_BLOCK_RESPONSE, body)
                 )
             else:
                 peer.try_send(
@@ -148,7 +153,10 @@ class BlocksyncReactor(Reactor):
         elif kind == _MSG_BLOCK_RESPONSE:
             f = pe.fields_dict(body)
             block = codec.decode_block(f[1][-1])
-            self.pool.add_block(peer.id, block)
+            ec = (
+                codec.decode_extended_commit(f[2][-1]) if 2 in f else None
+            )
+            self.pool.add_block(peer.id, block, ec)
         elif kind == _MSG_NO_BLOCK_RESPONSE:
             f = pe.fields_dict(body)
             self.pool.no_block(peer.id, pe.to_int64(f.get(1, [0])[-1]))
@@ -161,6 +169,36 @@ class BlocksyncReactor(Reactor):
             self.pool.set_peer_range(peer.id, base, height)
 
     # -- the sync loop (reference: reactor.go poolRoutine) -----------------
+
+    def _check_ext_commit(self, block, block_id, ec) -> Optional[str]:
+        """Validate a served extended commit.  The reference only checks
+        structure (ExtendedCommit.EnsureExtensions; reactor.go:559 has a
+        TODO about validating further) — we additionally verify +2/3 of
+        the commit signatures through the batch seam, so one malicious
+        peer cannot poison the stored ExtendedCommit that later feeds the
+        app's ExtendedCommitInfo.  Extension signatures themselves are
+        verified by consensus when the votes are used (as the reference
+        does)."""
+        if ec is None:
+            return "peer served no extended commit for an extension height"
+        if ec.height != block.header.height:
+            return f"extended commit height {ec.height} != block"
+        if ec.block_id != block_id:
+            return "extended commit is for a different block"
+        for cs in ec.extended_signatures:
+            if cs.for_block() and not cs.extension_signature:
+                return "commit signature missing its extension signature"
+        try:
+            validation.verify_commit_light(
+                self.state.chain_id,
+                self.state.validators,
+                block_id,
+                block.header.height,
+                ec.to_commit(),
+            )
+        except Exception as e:  # noqa: BLE001
+            return f"extended commit fails +2/3 verification: {e}"
+        return None
 
     def _pool_routine(self) -> None:
         last_status = 0.0
@@ -188,7 +226,9 @@ class BlocksyncReactor(Reactor):
     def _process_blocks(self) -> bool:
         """Verify + apply the frontier block using the NEXT block's
         LastCommit (reference: reactor.go:541)."""
-        first, second, first_peer, second_peer = self.pool.peek_two_blocks()
+        first, second, first_peer, second_peer, first_ext = (
+            self.pool.peek_two_blocks()
+        )
         if first is None or second is None:
             return False
         first_parts = first.make_part_set()
@@ -225,7 +265,28 @@ class BlocksyncReactor(Reactor):
                     if p is not None:
                         self.switch.stop_peer_for_error(p, e)
             return True
-        self.block_store.save_block(first, first_parts, second.last_commit)
+        ext_enabled = self.state.consensus_params.feature.vote_extensions_enable_height
+        need_ext = 0 < ext_enabled <= first.header.height
+        if need_ext:
+            err = self._check_ext_commit(first, first_id, first_ext)
+            if err is not None:
+                self.logger.error(
+                    "bad extended commit in blocksync",
+                    height=first.header.height,
+                    err=err,
+                )
+                self.pool.redo_request(first.header.height)
+                if self.switch is not None and first_peer:
+                    p = self.switch.get_peer(first_peer)
+                    if p is not None:
+                        self.switch.stop_peer_for_error(p, ValueError(err))
+                return True
+        self.block_store.save_block(
+            first,
+            first_parts,
+            second.last_commit,
+            extended_commit=first_ext if need_ext else None,
+        )
         self.state = self.block_exec.apply_verified_block(
             self.state, first_id, first
         )

@@ -57,10 +57,20 @@ _GOSSIP_SLEEP = 0.05
 _MAJ23_SLEEP = 2.0
 
 
+def _commit_sigs(commit):
+    """Signature list of a plain or extended commit (``is None`` test, not
+    truthiness: a decoded-empty extended signature list must not fall
+    through to a ``signatures`` attribute ExtendedCommit lacks)."""
+    ext = getattr(commit, "extended_signatures", None)
+    return commit.signatures if ext is None else ext
+
+
 def _commit_vote(commit, idx: int) -> Optional[Vote]:
     """Reconstruct validator idx's precommit from a stored commit
-    (reference: types/block.go Commit.GetByIndex)."""
-    cs = commit.signatures[idx]
+    (reference: types/block.go Commit.GetByIndex).  Works for plain and
+    extended commits — extended signatures restore the vote extension,
+    without which peers at extension-enabled heights reject the vote."""
+    cs = _commit_sigs(commit)[idx]
     if cs.absent():
         return None
     return Vote(
@@ -72,6 +82,8 @@ def _commit_vote(commit, idx: int) -> Optional[Vote]:
         validator_address=cs.validator_address,
         validator_index=idx,
         signature=cs.signature,
+        extension=getattr(cs, "extension", b""),
+        extension_signature=getattr(cs, "extension_signature", b""),
     )
 
 
@@ -540,17 +552,26 @@ class ConsensusReactor(Reactor):
                 return True
 
         if 0 < peer_height < our_height - 1 and peer_height >= self.block_store.base():
-            # catchup: send precommits reconstructed from the stored commit
-            commit = self.block_store.load_block_commit(peer_height)
+            # catchup: send precommits reconstructed from the stored
+            # commit — the EXTENDED commit at extension-enabled heights,
+            # since the peer rejects extension-less precommits there
+            # (reference: reactor.go gossipVotesForHeight:920-945)
+            ext_h = self.cs.state.consensus_params.feature.vote_extensions_enable_height
+            commit = None
+            if 0 < ext_h <= peer_height:
+                commit = self.block_store.load_extended_commit(peer_height)
+            if commit is None:
+                commit = self.block_store.load_block_commit(peer_height)
             if commit is not None:
+                sigs = _commit_sigs(commit)
                 bits = self._peer_vote_bits(
                     ps,
                     peer_height,
                     commit.round_,
                     PRECOMMIT_TYPE,
-                    len(commit.signatures),
+                    len(sigs),
                 )
-                for i, cs_sig in enumerate(commit.signatures):
+                for i, cs_sig in enumerate(sigs):
                     if cs_sig.absent():
                         continue
                     if i < len(bits) and bits[i]:

@@ -330,7 +330,7 @@ class TestBlocksyncBodyValidation:
                 self.redone = []
 
             def peek_two_blocks(self):
-                return b1, b2, "peer1", "peer2"
+                return b1, b2, "peer1", "peer2", None
 
             def redo_request(self, h):
                 self.redone.append(h)
