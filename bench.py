@@ -47,6 +47,12 @@ def _make_batch(n: int):
 
 def main() -> None:
     import jax
+
+    # same escape hatch as the CLI: axon's sitecustomize overrides the
+    # JAX_PLATFORMS env var, so CPU smoke-runs need a config-level pin
+    plat = os.environ.get("COMETBFT_TPU_JAX_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
     import jax.numpy as jnp
     import numpy as np
 
@@ -59,9 +65,31 @@ def main() -> None:
     n = int(os.environ.get("BENCH_BATCH", "131072"))
     reps = int(os.environ.get("BENCH_REPS", "5"))
 
+    impl = "pallas" if ov._use_pallas() else "xla"
     kernel = (
-        ov._verify_kernel_pallas if ov._use_pallas() else ov._verify_kernel
+        ov._verify_kernel_pallas if impl == "pallas" else ov._verify_kernel
     )
+
+    # Known-answer self-check of the chosen kernel at a small batch BEFORE
+    # the big timed run: a Mosaic lowering regression (or chip-side compile
+    # failure) must degrade to the XLA path with an honest "impl" field,
+    # not kill the benchmark (round-2 lesson: never ship an unchecked
+    # kernel as the only path).
+    if impl == "pallas":
+        try:
+            pubs, msgs, sigs = _make_batch(256)
+            arrays, _, _ = ov.prepare_batch(pubs, msgs, sigs)
+            small = {k: jnp.asarray(v) for k, v in arrays.items()}
+            ok = np.asarray(kernel(**small))[:256].all()
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas kernel failed ({e!r}); falling back to XLA",
+                  file=sys.stderr)
+            ok = False
+        if not ok:
+            impl, kernel = "xla", ov._verify_kernel
+            # verify_batch (the e2e measurement) re-selects its kernel via
+            # _use_pallas() — force the same fallback there
+            os.environ["COMETBFT_TPU_VERIFY_IMPL"] = "xla"
 
     def measure(batch):
         pubs, msgs, sigs = _make_batch(batch)
@@ -109,7 +137,7 @@ def main() -> None:
         "e2e_s": round(e2e_s, 6),
         "commit10k_ms": round(commit10k_s * 1e3, 3),
         "commit10k_device_est_ms": commit10k_dev_ms,
-        "impl": "pallas" if ov._use_pallas() else "xla",
+        "impl": impl,
         "platform": jax.devices()[0].platform,
     }
     print(json.dumps(result))

@@ -136,8 +136,16 @@ class BlocksyncReactor(Reactor):
                 body = pe.t_message(1, codec.encode_block(block), always=True)
                 # attach the extended commit when stored (vote extensions):
                 # a catching-up validator needs it to propose (reference:
-                # BlockResponse.ext_commit)
-                ec = self.block_store.load_extended_commit(height)
+                # BlockResponse.ext_commit).  Gated on the enable height:
+                # no store read on the serve path for extension-less chains.
+                ext_h = (
+                    self.state.consensus_params.feature.vote_extensions_enable_height
+                )
+                ec = (
+                    self.block_store.load_extended_commit(height)
+                    if 0 < ext_h <= height
+                    else None
+                )
                 if ec is not None:
                     body += pe.t_message(
                         2, codec.encode_extended_commit(ec), always=True
@@ -170,35 +178,18 @@ class BlocksyncReactor(Reactor):
 
     # -- the sync loop (reference: reactor.go poolRoutine) -----------------
 
-    def _check_ext_commit(self, block, block_id, ec) -> Optional[str]:
-        """Validate a served extended commit.  The reference only checks
-        structure (ExtendedCommit.EnsureExtensions; reactor.go:559 has a
-        TODO about validating further) — we additionally verify +2/3 of
-        the commit signatures through the batch seam, so one malicious
-        peer cannot poison the stored ExtendedCommit that later feeds the
-        app's ExtendedCommitInfo.  Extension signatures themselves are
-        verified by consensus when the votes are used (as the reference
-        does)."""
-        if ec is None:
-            return "peer served no extended commit for an extension height"
-        if ec.height != block.header.height:
-            return f"extended commit height {ec.height} != block"
-        if ec.block_id != block_id:
-            return "extended commit is for a different block"
-        for cs in ec.extended_signatures:
-            if cs.for_block() and not cs.extension_signature:
-                return "commit signature missing its extension signature"
-        try:
-            validation.verify_commit_light(
-                self.state.chain_id,
-                self.state.validators,
-                block_id,
-                block.header.height,
-                ec.to_commit(),
-            )
-        except Exception as e:  # noqa: BLE001
-            return f"extended commit fails +2/3 verification: {e}"
-        return None
+    def _check_ext_commit(
+        self, block, block_id, ec, second_last_commit
+    ) -> Optional[str]:
+        return check_ext_commit(
+            self.state.chain_id,
+            self.state.validators,
+            block,
+            block_id,
+            ec,
+            second_last_commit,
+        )
+
 
     def _pool_routine(self) -> None:
         last_status = 0.0
@@ -268,7 +259,9 @@ class BlocksyncReactor(Reactor):
         ext_enabled = self.state.consensus_params.feature.vote_extensions_enable_height
         need_ext = 0 < ext_enabled <= first.header.height
         if need_ext:
-            err = self._check_ext_commit(first, first_id, first_ext)
+            err = self._check_ext_commit(
+                first, first_id, first_ext, second.last_commit
+            )
             if err is not None:
                 self.logger.error(
                     "bad extended commit in blocksync",
@@ -323,3 +316,69 @@ class BlocksyncReactor(Reactor):
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(self.state)
         return True
+
+
+
+def check_ext_commit(
+    chain_id, validators, block, block_id, ec, second_last_commit
+) -> Optional[str]:
+    """Validate a served extended commit.  The reference only checks
+    structure (ExtendedCommit.EnsureExtensions; reactor.go:559 has a
+    TODO about validating further) — we additionally verify +2/3 of
+    the commit signatures (skipped when identical to the next block's
+    already-verified LastCommit) AND every extension signature, both
+    through the batch seam: extensions are NOT covered by the commit
+    signatures, so a structural check alone would let one malicious
+    peer serve real commit sigs with tampered extensions that later
+    feed the app's ExtendedCommitInfo."""
+    if ec is None:
+        return "peer served no extended commit for an extension height"
+    if ec.height != block.header.height:
+        return f"extended commit height {ec.height} != block"
+    if ec.block_id != block_id:
+        return "extended commit is for a different block"
+    for cs in ec.extended_signatures:
+        if cs.for_block() and not cs.extension_signature:
+            return "commit signature missing its extension signature"
+    base = ec.to_commit()
+    if base.signatures != second_last_commit.signatures:
+        # usually identical to the (already verified) next block's
+        # LastCommit; only a genuinely different signature set pays a
+        # second +2/3 verification
+        try:
+            validation.verify_commit_light(
+                chain_id,
+                validators,
+                block_id,
+                block.header.height,
+                base,
+            )
+        except Exception as e:  # noqa: BLE001
+            return f"extended commit fails +2/3 verification: {e}"
+    # Extension signatures are NOT covered by the commit signatures, so
+    # verify them against the validator keys through the batch seam —
+    # otherwise one malicious peer could serve real commit sigs with
+    # tampered extensions, poisoning the app's future ExtendedCommitInfo.
+    from cometbft_tpu.crypto import batch as cbatch
+    from cometbft_tpu.types.canonical import (
+        canonical_vote_extension_sign_bytes,
+    )
+
+    vals = validators.validators
+    bv = None
+    for i, cs in enumerate(ec.extended_signatures):
+        if not cs.for_block():
+            continue
+        if i >= len(vals):
+            return "extended commit has more signatures than validators"
+        msg = canonical_vote_extension_sign_bytes(
+            chain_id, ec.height, ec.round_, cs.extension
+        )
+        if bv is None:
+            bv = cbatch.create_batch_verifier(vals[i].pub_key)
+        bv.add(vals[i].pub_key, msg, cs.extension_signature)
+    if bv is not None:
+        ok, _bits = bv.verify()
+        if not ok:
+            return "extension signature verification failed"
+    return None

@@ -273,6 +273,40 @@ def test_extensions_verified_across_peers(tmp_path):
         # and both saw signed extensions from BOTH validators in a
         # PrepareProposal (each node proposes some heights)
         assert any(a.seen_ext_commits for a in apps)
+
+        # the blocksync ext-commit validator accepts the real artifact and
+        # rejects a tampered-extension copy (extensions are NOT covered by
+        # the commit signatures — this check is the poisoning defense)
+        import dataclasses as dc
+
+        from cometbft_tpu.blocksync.reactor import check_ext_commit
+        from cometbft_tpu.types.basic import BlockID
+
+        n0 = nodes[0]
+        h = 2
+        ec = n0.block_store.load_extended_commit(h)
+        blk = n0.block_store.load_block(h)
+        meta = n0.block_store.load_block_meta(h)
+        state = n0.consensus.state
+        vals = n0.state_store.load_validators(1)
+        nxt = n0.block_store.load_block(h + 1)
+        assert (
+            check_ext_commit(
+                "ext-net-chain", vals, blk, meta.block_id, ec, nxt.last_commit
+            )
+            is None
+        )
+        bad_sigs = [
+            dc.replace(s, extension=s.extension + b"!")
+            if s.for_block()
+            else s
+            for s in ec.extended_signatures
+        ]
+        bad_ec = dc.replace(ec, extended_signatures=bad_sigs)
+        err = check_ext_commit(
+            "ext-net-chain", vals, blk, meta.block_id, bad_ec, nxt.last_commit
+        )
+        assert err is not None and "extension signature" in err
     finally:
         for n in nodes:
             n.stop()
