@@ -365,7 +365,7 @@ def check_ext_commit(
     )
 
     vals = validators.validators
-    bv = None
+    entries = []
     for i, cs in enumerate(ec.extended_signatures):
         if not cs.for_block():
             continue
@@ -374,11 +374,21 @@ def check_ext_commit(
         msg = canonical_vote_extension_sign_bytes(
             chain_id, ec.height, ec.round_, cs.extension
         )
-        if bv is None:
-            bv = cbatch.create_batch_verifier(vals[i].pub_key)
-        bv.add(vals[i].pub_key, msg, cs.extension_signature)
-    if bv is not None:
+        entries.append((vals[i].pub_key, msg, cs.extension_signature))
+    # batch when every key supports it (same discipline as
+    # validation._verify_commit); per-signature fallback otherwise —
+    # secp256k1/bls12_381 validators must not stall blocksync
+    if len(entries) >= 2 and all(
+        cbatch.supports_batch_verifier(pk) for pk, _, _ in entries
+    ):
+        bv = cbatch.create_batch_verifier(entries[0][0])
+        for pk, msg, sig in entries:
+            bv.add(pk, msg, sig)
         ok, _bits = bv.verify()
         if not ok:
             return "extension signature verification failed"
+    else:
+        for pk, msg, sig in entries:
+            if not pk.verify_signature(msg, sig):
+                return "extension signature verification failed"
     return None

@@ -454,10 +454,13 @@ class Testnet:
         }
 
 
-def run(manifest_path: str, workdir: str) -> dict:
+def run(manifest_path: str, workdir: str, overrides: dict | None = None) -> dict:
     """Full pipeline; returns summary stats.  CLI: python -m e2e.runner
-    <manifest.toml> [workdir]."""
+    <manifest.toml> [workdir].  ``overrides`` patches manifest fields
+    (e.g. load_tx_rate for QA rate sweeps, scripts/qa_report.py)."""
     m = load_manifest(manifest_path)
+    for k, v in (overrides or {}).items():
+        setattr(m, k, v)
     net = Testnet(m, workdir)
     net.setup()
     summary = {}
@@ -476,6 +479,7 @@ def run(manifest_path: str, workdir: str) -> dict:
             "sent": sent,
             "report": str(rep) if rep else "no loadtime txs committed",
         }
+        summary["loadtime"] = rep  # structured, for qa_report.py
     finally:
         net.stop()
     return summary

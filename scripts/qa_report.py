@@ -1,0 +1,90 @@
+"""QA report: run a testnet manifest at increasing load rates and emit a
+markdown report of block intervals and tx latencies per rate — the
+method of the reference's QA process (docs/references/qa/method.md:
+saturation search over (connections, rate) cells, then latency/interval
+statistics per cell; plotting in scripts/qa/reporting).
+
+Usage:
+    python scripts/qa_report.py e2e/manifests/basic.toml [rates...]
+
+Writes the report to stdout; one testnet run per rate.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from e2e import runner
+
+
+def run_cell(manifest_path: str, rate: int, workdir: str):
+    """One QA cell: the full e2e pipeline (incl. late joiners and
+    perturbations from the manifest) at ``rate`` tx/s."""
+    summary = runner.run(
+        manifest_path, workdir, overrides={"load_tx_rate": rate}
+    )
+    return (
+        summary["benchmark"],
+        summary["loadtime"],
+        summary["load"]["sent"],
+    )
+
+
+def fmt_report(cells) -> str:
+    out = [
+        "# QA report",
+        "",
+        "Method: reference docs/references/qa/method.md — per-rate cells,",
+        "block-interval statistics and tx latency percentiles.",
+        "",
+        "| rate (tx/s) | sent | committed | lat p50 | lat p99 | lat max |"
+        " block interval avg | interval max |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for rate, bench, rep, sent in cells:
+        if rep is None:
+            out.append(f"| {rate} | {sent} | 0 | - | - | - |"
+                       f" {bench.get('interval_avg_s', 0):.2f}s |"
+                       f" {bench.get('interval_max_s', 0):.2f}s |")
+            continue
+        out.append(
+            f"| {rate} | {sent} | {rep.txs} | {rep.p50_s*1e3:.0f}ms |"
+            f" {rep.p99_s*1e3:.0f}ms | {rep.max_s*1e3:.0f}ms |"
+            f" {bench.get('interval_avg_s', 0):.2f}s |"
+            f" {bench.get('interval_max_s', 0):.2f}s |"
+        )
+    # saturation estimate: first rate where committed < 80% of sent
+    sat = None
+    for rate, _, rep, sent in cells:
+        if rep is None or (sent and rep.txs < 0.8 * sent):
+            sat = rate
+            break
+    out.append("")
+    out.append(
+        f"Saturation estimate: {'not reached' if sat is None else f'~{sat} tx/s'}"
+        f" over {len(cells)} cells."
+    )
+    return "\n".join(out)
+
+
+def main() -> int:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        return 2
+    manifest = sys.argv[1]
+    rates = [int(r) for r in sys.argv[2:]] or [10, 50, 200]
+    cells = []
+    for rate in rates:
+        workdir = f"/tmp/qa-{int(time.time())}-{rate}"
+        os.makedirs(workdir, exist_ok=True)
+        print(f"-- cell rate={rate} tx/s --", file=sys.stderr)
+        bench, rep, sent = run_cell(manifest, rate, workdir)
+        cells.append((rate, bench, rep, sent))
+    print(fmt_report(cells))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,35 @@ def test_extensions_flow_into_next_proposal(tmp_path):
     ec = node.block_store.load_extended_commit(2)
     assert ec is not None
 
+    # single-validator ec exercises check_ext_commit's PER-SIGNATURE
+    # fallback branch (one entry -> no batching, as with non-ed25519
+    # validator keys): genuine passes, tampered extension rejected
+    import dataclasses as dc
+
+    from cometbft_tpu.blocksync.reactor import check_ext_commit
+
+    blk = node.block_store.load_block(2)
+    meta = node.block_store.load_block_meta(2)
+    nxt = node.block_store.load_block(3)
+    vals = node.state_store.load_validators(1)
+    assert (
+        check_ext_commit(
+            "ext-chain", vals, blk, meta.block_id, ec, nxt.last_commit
+        )
+        is None
+    )
+    bad = dc.replace(
+        ec,
+        extended_signatures=[
+            dc.replace(s, extension=s.extension + b"?") if s.for_block() else s
+            for s in ec.extended_signatures
+        ],
+    )
+    err = check_ext_commit(
+        "ext-chain", vals, blk, meta.block_id, bad, nxt.last_commit
+    )
+    assert err is not None and "extension signature" in err
+
 
 def test_late_joining_validator_proposes_after_blocksync(tmp_path):
     """With extensions enabled, a validator that joins late catches up
@@ -280,14 +309,12 @@ def test_extensions_verified_across_peers(tmp_path):
         import dataclasses as dc
 
         from cometbft_tpu.blocksync.reactor import check_ext_commit
-        from cometbft_tpu.types.basic import BlockID
 
         n0 = nodes[0]
         h = 2
         ec = n0.block_store.load_extended_commit(h)
         blk = n0.block_store.load_block(h)
         meta = n0.block_store.load_block_meta(h)
-        state = n0.consensus.state
         vals = n0.state_store.load_validators(1)
         nxt = n0.block_store.load_block(h + 1)
         assert (
@@ -307,6 +334,7 @@ def test_extensions_verified_across_peers(tmp_path):
             "ext-net-chain", vals, blk, meta.block_id, bad_ec, nxt.last_commit
         )
         assert err is not None and "extension signature" in err
+
     finally:
         for n in nodes:
             n.stop()
