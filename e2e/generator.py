@@ -1,0 +1,130 @@
+"""Random testnet-manifest generator for config-space search.
+
+Reference: test/e2e/generator — nightly CI generates randomized
+manifests (topology, ABCI flavor, sync modes, perturbations)
+and runs them, exploring configuration corners no hand-written manifest
+covers.
+
+    python -m e2e.generator --seed 7 --out /tmp/gen      # write .toml files
+    python -m e2e.generator --seed 7 --run               # generate + run one
+"""
+
+from __future__ import annotations
+
+import random
+
+from e2e.manifest import Manifest, NodeManifest
+
+
+def generate(seed: int) -> Manifest:
+    """One random-but-valid manifest; deterministic in the seed."""
+    rng = random.Random(seed)
+    n_validators = rng.randint(2, 4)
+    m = Manifest(
+        chain_id=f"gen-{seed}",
+        wait_height=rng.randint(4, 8),
+        load_tx_rate=rng.choice([5, 20, 50]),
+        load_tx_bytes=rng.choice([64, 256, 1024]),
+    )
+    for i in range(n_validators):
+        nm = NodeManifest(name=f"validator{i:02d}")
+        # keep quorum alive: at most one validator gets a perturbation
+        m.nodes.append(nm)
+    perturbable = rng.randrange(n_validators)
+    if rng.random() < 0.7:
+        m.nodes[perturbable].perturb = [
+            rng.choice(["kill", "pause", "restart", "disconnect"])
+        ]
+    # sometimes a socket/grpc-ABCI validator (separate app process)
+    if rng.random() < 0.5:
+        m.nodes[rng.randrange(n_validators)].abci_protocol = rng.choice(
+            ["socket", "grpc"]
+        )
+    # sometimes a late-joining full node, possibly via state sync
+    if rng.random() < 0.6:
+        start_at = rng.randint(2, 6)
+        m.nodes.append(
+            NodeManifest(
+                name="full01",
+                mode="full",
+                start_at=start_at,
+                state_sync=rng.random() < 0.5,
+            )
+        )
+    m.validate()
+    return m
+
+
+def to_toml(m: Manifest) -> str:
+    out = [
+        f'chain_id = "{m.chain_id}"',
+        f"wait_height = {m.wait_height}",
+        f"load_tx_rate = {m.load_tx_rate}",
+        f"load_tx_bytes = {m.load_tx_bytes}",
+        "",
+    ]
+    for n in m.nodes:
+        out.append(f"[node.{n.name}]")
+        out.append(f'mode = "{n.mode}"')
+        if n.key_type != "ed25519":
+            out.append(f'key_type = "{n.key_type}"')
+        if n.abci_protocol != "builtin":
+            out.append(f'abci_protocol = "{n.abci_protocol}"')
+        if n.start_at:
+            out.append(f"start_at = {n.start_at}")
+        if n.state_sync:
+            out.append("state_sync = true")
+        if n.perturb:
+            out.append(
+                "perturb = [" + ", ".join(f'"{p}"' for p in n.perturb) + "]"
+            )
+        out.append("")
+    return "\n".join(out)
+
+
+def main() -> int:
+    import argparse
+    import os
+    import sys
+    import time
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=int(time.time()))
+    ap.add_argument("--count", type=int, default=4)
+    ap.add_argument("--out", default=None, help="directory for .toml files")
+    ap.add_argument(
+        "--run", action="store_true", help="generate one manifest and run it"
+    )
+    args = ap.parse_args()
+
+    if args.run:
+        import json
+        import tempfile
+
+        from e2e import runner
+
+        m = generate(args.seed)
+        workdir = tempfile.mkdtemp(prefix="e2e-gen-")
+        path = os.path.join(workdir, "manifest.toml")
+        with open(path, "w") as f:
+            f.write(to_toml(m))
+        print(to_toml(m), file=sys.stderr)
+        summary = runner.run(path, workdir)
+        print(json.dumps(summary, indent=2, default=str))
+        return 0
+
+    outdir = args.out or "."
+    os.makedirs(outdir, exist_ok=True)
+    for i in range(args.count):
+        m = generate(args.seed + i)
+        path = os.path.join(outdir, f"gen-{args.seed + i}.toml")
+        with open(path, "w") as f:
+            f.write(to_toml(m))
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
