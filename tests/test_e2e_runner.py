@@ -162,6 +162,12 @@ class TestGenerator:
                 for n in mm.nodes
             }
             assert by_name(m2) == by_name(m)
+            assert (
+                m2.chain_id,
+                m2.wait_height,
+                m2.load_tx_rate,
+                m2.load_tx_bytes,
+            ) == (m.chain_id, m.wait_height, m.load_tx_rate, m.load_tx_bytes)
 
     def test_generated_net_runs(self, tmp_path):
         """One generated manifest actually runs end to end (the seed
@@ -176,6 +182,7 @@ class TestGenerator:
                 len(m.nodes) == 2
                 and m.wait_height <= 5
                 and all(n.abci_protocol == "builtin" for n in m.nodes)
+                and not any(n.perturb for n in m.nodes)
             )
 
         seed = next(s for s in range(500) if fast(s))
