@@ -67,7 +67,17 @@ def _decompress_pair(ya, sa, yr, sr):
     """Decompress A and R as ONE double-width batch: the ~250-square
     sqrt chain is traced/issued once over (20, 2B) instead of twice over
     (20, B) — half the instruction count for the same flops, which is
-    what matters when the kernel is issue-bound rather than ALU-bound."""
+    what matters when the kernel is issue-bound rather than ALU-bound.
+
+    COMETBFT_TPU_MERGED_DECOMPRESS=0 falls back to two separate
+    decompressions (bisection escape hatch: the lane-axis concatenate is
+    the one construct here Mosaic has not lowered for us before)."""
+    import os as _os
+
+    if _os.environ.get("COMETBFT_TPU_MERGED_DECOMPRESS", "1") == "0":
+        ok_a, a = ep.decompress(ya, sa)
+        ok_r, r = ep.decompress(yr, sr)
+        return ok_a, a, ok_r, r
     t = ya.v.shape[1]
     y_all = fe.F(jnp.concatenate([ya.v, yr.v], axis=1), 0, fe.MASK)
     s_all = jnp.concatenate([sa, sr])
