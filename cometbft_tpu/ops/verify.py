@@ -71,7 +71,11 @@ def _decompress_pair(ya, sa, yr, sr):
 
     COMETBFT_TPU_MERGED_DECOMPRESS=0 falls back to two separate
     decompressions (bisection escape hatch: the lane-axis concatenate is
-    the one construct here Mosaic has not lowered for us before)."""
+    the one construct here Mosaic has not lowered for us before).
+    TRACE-TIME ONLY: set it before the process's first verify — jit and
+    kernel caches are keyed on shapes, not env vars, so toggling later
+    does not retrace already-compiled batch sizes (unlike
+    COMETBFT_TPU_VERIFY_IMPL, which selects per call outside jit)."""
     import os as _os
 
     if _os.environ.get("COMETBFT_TPU_MERGED_DECOMPRESS", "1") == "0":
