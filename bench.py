@@ -1,38 +1,94 @@
 """Headline benchmark: Ed25519 batch-verify throughput on one chip.
 
-Prints ONE JSON line:
+Emits incremental one-line JSON results (smallest batch first) and ALWAYS
+finishes with a final headline line:
   {"metric": ..., "value": N, "unit": "verifies/s", "vs_baseline": N/500000}
+The driver keeps the tail of stdout, so every line printed here is a
+complete, parseable record — whatever line happens to be last is an honest
+summary of the best completed measurement.
 
-Baseline (BASELINE.json): >=500k verifies/sec/chip, the north-star target for
-the TPU backend of the commit-verification hot path (SURVEY.md §3.4).
-Also measures (and reports in extra fields) the 10k-validator commit-verify
-latency target (<5 ms p50, device-kernel portion).
+Round-3 lesson (VERDICT r3): the axon tunnel to the chip wedges for long
+stretches — platform init, compiles, and dispatches can hang indefinitely.
+This harness therefore runs all chip work in KILLABLE SUBPROCESSES driven
+by an orchestrator that never imports jax itself:
+
+  orchestrator ──┬── cpu worker (parallel insurance: honest "platform:cpu"
+                 │    number if the chip never responds)
+                 ├── probe subprocess (bounded; 2 attempts)
+                 └── tpu worker (streams a JSON line per stage; per-line
+                      progress watchdog; killed on stall, partial results
+                      kept)
+
+The tpu worker AOT-caches the compiled Pallas executable on disk
+(ops/aot_cache.py) in addition to JAX's persistent compilation cache, so a
+warm second run skips the minutes-long Mosaic compile entirely.
+
+Baseline (BASELINE.json): >=500k verifies/sec/chip on the commit-verify
+hot path (SURVEY.md §3.4; reference seam crypto/ed25519/ed25519.go:189-222
++ types/validation.go:220-324).  Also reports the 10k-validator commit
+latency target (<5 ms device portion).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
 import sys
+import threading
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 BASELINE_VERIFIES_PER_SEC = 500_000.0
 
+# Stage batch sizes, smallest first: a stall mid-run still leaves the best
+# completed number on stdout.  10240 is the 10k-validator commit shape.
+TPU_BATCHES = (8192, 10240, 32768, 131072)
+CPU_BATCHES = (1024,)
+
+_CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax"
+    ),
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "-1",
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "2",
+}
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+# --------------------------------------------------------------------------
+# worker (runs in a subprocess; may hang — the orchestrator kills on stall)
+# --------------------------------------------------------------------------
+
+
+def _retry_unavailable(fn, attempts: int = 3, backoff_s: float = 5.0):
+    """Bounded retry for the tunnel's transient UNAVAILABLE dispatch errors."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            msg = str(e)
+            if "UNAVAILABLE" not in msg and "DEADLINE" not in msg:
+                raise
+            if i == attempts - 1:
+                raise
+            time.sleep(backoff_s * (i + 1))
+
 
 def _make_batch(n: int):
-    """n (pub, msg, sig) triples: up to 2048 distinct python-oracle
+    """n (pub, msg, sig) triples: up to 1024 distinct python-oracle
     signatures, tiled to n.  The device work is data-independent per lane
-    (branch-free ladder), so tiling does not flatter the throughput
-    number; it just keeps host-side signing (pure python big-int, ~4 ms
-    per signature) out of the benchmark's setup time."""
+    (branch-free ladder), so tiling does not flatter the throughput number;
+    it keeps host-side signing (~4 ms/sig pure python) out of setup time."""
     from cometbft_tpu.crypto import ed25519_ref as ref
 
-    distinct = min(n, 2048)
+    distinct = min(n, 1024)
     pubs, msgs, sigs = [], [], []
     for i in range(distinct):
         seed = i.to_bytes(4, "little") * 8
@@ -45,102 +101,479 @@ def _make_batch(n: int):
     return (pubs * reps)[:n], (msgs * reps)[:n], (sigs * reps)[:n]
 
 
-def main() -> None:
-    import jax
-
-    # same escape hatch as the CLI: axon's sitecustomize overrides the
-    # JAX_PLATFORMS env var, so CPU smoke-runs need a config-level pin
-    plat = os.environ.get("COMETBFT_TPU_JAX_PLATFORM")
-    if plat:
-        jax.config.update("jax_platforms", plat)
-    import jax.numpy as jnp
-    import numpy as np
-
-    from cometbft_tpu.ops import verify as ov
-
-    # Default batch: large enough to amortize the per-dispatch floor
-    # (~30-70 ms through the axon tunnel; measured in
-    # scripts/bench_pallas_profile.py — dispatches do not pipeline, so
-    # within-dispatch batching is the only amortization).
-    n = int(os.environ.get("BENCH_BATCH", "131072"))
-    reps = int(os.environ.get("BENCH_REPS", "5"))
-
-    impl = "pallas" if ov._use_pallas() else "xla"
-    kernel = (
-        ov._verify_kernel_pallas if impl == "pallas" else ov._verify_kernel
-    )
-
-    # Known-answer self-check of the chosen kernel at a small batch BEFORE
-    # the big timed run: a Mosaic lowering regression (or chip-side compile
-    # failure) must degrade to the XLA path with an honest "impl" field,
-    # not kill the benchmark (round-2 lesson: never ship an unchecked
-    # kernel as the only path).
-    if impl == "pallas":
-        try:
-            pubs, msgs, sigs = _make_batch(256)
-            arrays, _, _ = ov.prepare_batch(pubs, msgs, sigs)
-            small = {k: jnp.asarray(v) for k, v in arrays.items()}
-            ok = np.asarray(kernel(**small))[:256].all()
-        except Exception as e:  # noqa: BLE001
-            print(f"pallas kernel failed ({e!r}); falling back to XLA",
-                  file=sys.stderr)
-            ok = False
-        if not ok:
-            impl, kernel = "xla", ov._verify_kernel
-            # verify_batch (the e2e measurement) re-selects its kernel via
-            # _use_pallas() — force the same fallback there
-            os.environ["COMETBFT_TPU_VERIFY_IMPL"] = "xla"
-
-    def measure(batch):
-        pubs, msgs, sigs = _make_batch(batch)
-        arrays, _, _ = ov.prepare_batch(pubs, msgs, sigs)
-        dev = {k: jnp.asarray(v) for k, v in arrays.items()}
-        accept = np.asarray(kernel(**dev))
-        assert accept[:batch].all(), "benchmark batch failed to verify"
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            np.asarray(kernel(**dev))
-            times.append(time.perf_counter() - t0)
-        return min(times), (pubs, msgs, sigs)
-
-    # Device-kernel throughput (arrays resident) at the headline batch.
-    kernel_s, (pubs, msgs, sigs) = measure(n)
-    vps = n / kernel_s
-
-    # 10k-validator commit shape, measured directly (10240 bucket).
-    commit10k_s, _ = measure(10_240)
-
-    # End-to-end (host prep incl. SHA-512 + packing + transfer + kernel).
-    t0 = time.perf_counter()
-    bits = ov.verify_batch(pubs, msgs, sigs)
-    e2e_s = time.perf_counter() - t0
-    assert bits.all()
-
-    # Device-compute estimate for the 10k commit from the measured slope
-    # between the two batch sizes (subtracts the fixed dispatch floor the
-    # tunnel adds to every call; BASELINE's <5 ms target is specified as
-    # the device-kernel portion).
-    if n > 10_240:
-        slope = (kernel_s - commit10k_s) / (n - 10_240)
-        commit10k_dev_ms = round(max(slope, 0.0) * 10_240 * 1e3, 3)
-    else:
-        commit10k_dev_ms = None  # no second batch size to take a slope from
-
-    result = {
+def _result_line(stage: str, vps: float, extra: dict) -> dict:
+    out = {
         "metric": "ed25519_batch_verify_throughput",
         "value": round(vps, 1),
         "unit": "verifies/s",
         "vs_baseline": round(vps / BASELINE_VERIFIES_PER_SEC, 4),
-        "batch": n,
-        "kernel_s": round(kernel_s, 6),
-        "e2e_s": round(e2e_s, 6),
-        "commit10k_ms": round(commit10k_s * 1e3, 3),
-        "commit10k_device_est_ms": commit10k_dev_ms,
-        "impl": impl,
-        "platform": jax.devices()[0].platform,
+        "stage": stage,
     }
-    print(json.dumps(result))
+    out.update(extra)
+    return out
+
+
+def _worker_cpu() -> None:
+    """CPU insurance path: this box may have ONE core, where the XLA-CPU
+    build of the kernel runs ~2 verifies/s — a meaningless measure of the
+    TPU design.  The honest no-chip-available number is the pure-Python
+    host oracle (the consensus fallback `crypto/batch.py` actually uses
+    when no accelerator backend passes its self-check)."""
+    from cometbft_tpu.crypto import ed25519_ref as ref
+
+    n = 256
+    pubs, msgs, sigs = _make_batch(n)
+    t0 = time.perf_counter()
+    ok = all(
+        ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+    )
+    t = time.perf_counter() - t0
+    assert ok
+    vps = n / t
+    _emit(
+        _result_line(
+            f"batch-{n}", vps,
+            dict(impl="host-oracle", platform="cpu", partial=True, batch=n),
+        )
+    )
+    _emit(
+        _result_line(
+            "final", vps,
+            dict(impl="host-oracle", platform="cpu", batch=n,
+                 note="chip unavailable; python-oracle consensus fallback"),
+        )
+    )
+
+
+def worker(platform_mode: str) -> None:
+    """Measure stages smallest-first, emitting a JSON line after each.
+
+    Per-batch flow is compile -> validate (first batch only) -> measure ->
+    emit, so a tunnel stall during a LATER compile still leaves every
+    completed batch's number on stdout."""
+    import jax
+
+    if platform_mode == "cpu":
+        try:
+            # may raise if sitecustomize already initialized backends; the
+            # host-oracle path below never touches jax, so proceed anyway
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        _worker_cpu()
+        return
+    # The axon sitecustomize imports jax at interpreter start, BEFORE this
+    # module body runs — env vars set here are read too late.  Config
+    # updates work at any point before the first compile, so pin the
+    # persistent cache at the config level (round-3 root cause: the cache
+    # was silently "disabled/not initialized" the whole round).
+    jax.config.update(
+        "jax_compilation_cache_dir", _CACHE_ENV["JAX_COMPILATION_CACHE_DIR"]
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cometbft_tpu.ops import aot_cache
+    from cometbft_tpu.ops import verify as ov
+
+    platform = jax.devices()[0].platform
+    impl = "pallas" if ov._use_pallas() else "xla"
+    jitted = ov._verify_kernel_pallas if impl == "pallas" else ov._verify_kernel
+    batches = TPU_BATCHES
+    cap = os.environ.get("BENCH_BATCH")  # bound the sweep (legacy knob)
+    if cap:
+        cap_n = int(cap)
+        batches = tuple(b for b in TPU_BATCHES if b <= cap_n) or (cap_n,)
+        if cap_n not in batches:
+            batches = tuple(sorted(set(batches) | {cap_n}))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+
+    def measure(call, kw, b: int) -> float:
+        accept = np.asarray(_retry_unavailable(lambda: call(**kw)))
+        assert accept[:b].all(), f"batch {b} failed to verify"
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(_retry_unavailable(lambda: call(**kw)))
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    stage_s = {}
+    prep = {}
+    for i, b in enumerate(batches):
+        pubs, msgs, sigs = _make_batch(b)
+        arrays, _, _ = ov.prepare_batch(pubs, msgs, sigs)
+        kw = {k: jnp.asarray(v) for k, v in arrays.items()}
+        # heartbeat BEFORE the (possibly minutes-long) compile: the
+        # orchestrator grants compile-sized stall budgets only while the
+        # latest line is a compile-start marker
+        _emit(
+            _result_line(
+                f"compile-{b}", 0.0,
+                dict(impl=impl, platform=platform, partial=True, batch=b),
+            )
+        )
+        call, info = _retry_unavailable(
+            lambda: aot_cache.load_or_compile(
+                jitted, kw, f"verify-{impl}-{arrays['s_ok'].shape[0]}"
+            )
+        )
+        prep[b] = (pubs, msgs, sigs)
+        if i == 0:
+            # correctness of the COMPILED artifact before any timed run:
+            # known-answer + tampered vectors padded into this batch shape
+            from scripts import chip_validate
+
+            verdict = chip_validate.validate_with(
+                lambda **kws: np.asarray(_retry_unavailable(lambda: call(**kws))),
+                bucket=arrays["s_ok"].shape[0],
+            )
+            chip_validate.write_artifact(verdict, impl=impl, platform=platform)
+            _emit(
+                _result_line(
+                    "chip_validate", 0.0,
+                    dict(impl=impl, platform=platform, partial=True,
+                         chip_validate_ok=verdict["ok"],
+                         vectors=verdict["n_vectors"]),
+                )
+            )
+            if not verdict["ok"]:
+                # broken bits: a throughput number would be meaningless
+                sys.exit(3)
+        t = measure(call, kw, b)
+        stage_s[b] = t
+        _emit(
+            _result_line(
+                f"batch-{b}", b / t,
+                dict(impl=impl, platform=platform, partial=True, batch=b,
+                     kernel_s=round(t, 6), **info),
+            )
+        )
+
+    # end-to-end at the largest batch (host SHA-512/packing + transfer +
+    # dispatch) — the number consensus actually sees.
+    eb = batches[-1]
+    pubs, msgs, sigs = prep[eb]
+    t0 = time.perf_counter()
+    bits = _retry_unavailable(lambda: ov.verify_batch(pubs, msgs, sigs))
+    e2e_s = time.perf_counter() - t0
+    assert bits.all()
+
+    # final summary: headline = best throughput stage; device-time estimate
+    # for the 10k commit from the slope between the two largest batches
+    # (subtracts the tunnel's fixed per-dispatch floor; BASELINE's <5 ms
+    # target is the device-kernel portion).
+    best_b = max(batches, key=lambda b: b / stage_s[b])
+    vps = best_b / stage_s[best_b]
+    extra = dict(
+        impl=impl,
+        platform=platform,
+        batch=best_b,
+        kernel_s=round(stage_s[best_b], 6),
+        e2e_s=round(e2e_s, 6),
+        e2e_vps=round(eb / e2e_s, 1),
+    )
+    if 10240 in stage_s:
+        extra["commit10k_ms"] = round(stage_s[10240] * 1e3, 3)
+    b1, b2 = batches[-2], batches[-1]
+    if b2 > b1:
+        slope = (stage_s[b2] - stage_s[b1]) / (b2 - b1)
+        extra["commit10k_device_est_ms"] = round(max(slope, 0.0) * 10240 * 1e3, 3)
+        extra["dispatch_floor_ms"] = round(
+            max(stage_s[b1] - slope * b1, 0.0) * 1e3, 1
+        )
+    _emit(_result_line("final", vps, extra))
+
+
+# --------------------------------------------------------------------------
+# probe
+# --------------------------------------------------------------------------
+
+
+def probe() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    d = jax.devices()
+    x = np.asarray(jnp.ones((256, 256)) @ jnp.ones((256, 256)))
+    assert float(x[0, 0]) == 256.0
+    _emit({"probe": "ok", "platform": d[0].platform,
+           "init_s": round(time.time() - t0, 1)})
+
+
+# --------------------------------------------------------------------------
+# orchestrator
+# --------------------------------------------------------------------------
+
+
+class _Stream:
+    """A worker subprocess whose stdout JSON lines are collected by a
+    reader thread; the orchestrator polls for fresh lines with a stall
+    watchdog and can kill the process at any time."""
+
+    def __init__(self, mode: str, env: dict):
+        self.stderr_path = os.path.join(
+            "/tmp", f"bench-worker-{mode}-{os.getpid()}-{time.time_ns()}.err"
+        )
+        self._errf = open(self.stderr_path, "w")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__), "--worker", mode],
+            stdout=subprocess.PIPE,
+            stderr=self._errf,
+            text=True,
+            env=env,
+            cwd=REPO,
+        )
+        self.killed = False
+        self.lines: list = []
+        self.last_line_t = time.monotonic()
+        self._thread = threading.Thread(target=self._read, daemon=True)
+        self._thread.start()
+
+    def stderr_tail(self, max_chars: int = 400) -> str:
+        try:
+            self._errf.flush()
+            with open(self.stderr_path) as f:
+                return f.read()[-max_chars:]
+        except OSError:
+            return ""
+
+    def _read(self):
+        for line in self.proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                self.lines.append(json.loads(line))
+            except ValueError:
+                continue
+            self.last_line_t = time.monotonic()
+
+    def results(self):
+        return list(self.lines)
+
+    def alive(self):
+        return self.proc.poll() is None
+
+    def kill(self):
+        if self.alive():
+            self.killed = True
+            self.proc.kill()
+
+    def cleanup(self):
+        """Close the stderr handle and remove the temp file (call once the
+        stream's records/stderr have been consumed)."""
+        try:
+            self._errf.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.stderr_path)
+        except OSError:
+            pass
+
+
+def _run_tpu_worker(env: dict, remaining) -> "_Stream":
+    """Launch a tpu worker and stream its lines with a per-line progress
+    watchdog: the first stages may include a minutes-long Mosaic compile;
+    later stages must tick faster.  Returns the finished _Stream (records
+    via .results(); crash/kill state via .proc.returncode / .killed)."""
+    tpu = _Stream("tpu", env)
+    n_seen = 0
+    results: list = []
+    while True:
+        # generous budget before the first line and during any compile
+        # (the worker emits a compile-<batch> heartbeat before each one —
+        # cold caches mean EVERY batch shape can cost a Mosaic compile)
+        in_compile = n_seen == 0 or str(
+            results[-1].get("stage", "")
+        ).startswith("compile-")
+        stall_limit = 600.0 if in_compile else 270.0
+        stall_limit = min(stall_limit, max(remaining() - 120.0, 60.0))
+        if len(tpu.results()) > n_seen:
+            for rec in tpu.results()[n_seen:]:
+                _emit(rec)  # re-emit so the driver's tail has them
+            n_seen = len(tpu.results())
+            results = tpu.results()
+            if results and results[-1].get("stage") == "final":
+                break
+            continue
+        if not tpu.alive():
+            tpu._thread.join(timeout=3.0)
+            if len(tpu.results()) > n_seen:
+                continue
+            break
+        if time.monotonic() - tpu.last_line_t > stall_limit:
+            tpu.kill()
+            _emit(
+                _result_line(
+                    "tpu-stalled", 0.0,
+                    dict(partial=True,
+                         after_stages=[r.get("stage") for r in results]),
+                )
+            )
+            break
+        if remaining() < 90.0:
+            tpu.kill()
+            break
+        time.sleep(1.0)
+    if not tpu.alive():
+        tpu.proc.wait()  # populate returncode for crash detection
+    return tpu
+
+
+def _worker_env(platform_mode: str) -> dict:
+    env = dict(os.environ)
+    env.update(_CACHE_ENV)
+    if platform_mode == "cpu":
+        # axon's sitecustomize overrides JAX_PLATFORMS; the worker also
+        # pins at the config level — both, for belt and braces
+        env["COMETBFT_TPU_JAX_PLATFORM"] = "cpu"
+    return env
+
+
+def orchestrate() -> None:
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1140"))
+    t_start = time.monotonic()
+
+    def remaining() -> float:
+        return budget - (time.monotonic() - t_start)
+
+    # CPU insurance worker: starts immediately, runs in parallel; its
+    # result is used only if the chip never delivers.
+    cpu = _Stream("cpu", _worker_env("cpu"))
+    streams = [cpu]
+
+    # Probe the chip (bounded, 2 attempts).
+    probe_ok = False
+    probe_info = {}
+    for attempt in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-u", os.path.abspath(__file__), "--probe"],
+                capture_output=True,
+                text=True,
+                timeout=min(100.0, max(remaining() - 600, 30.0)),
+                env=_worker_env("tpu"),
+                cwd=REPO,
+            )
+            for line in out.stdout.splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("probe") == "ok":
+                    probe_ok = True
+                    probe_info = rec
+            if probe_ok:
+                break
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt == 0:
+            time.sleep(10)
+    _emit(
+        _result_line(
+            "probe", 0.0,
+            dict(partial=True, probe_ok=probe_ok, **probe_info),
+        )
+    )
+
+    tpu_results = []
+    if probe_ok and probe_info.get("platform") == "tpu":
+        stream = _run_tpu_worker(_worker_env("tpu"), remaining)
+        streams.append(stream)
+        tpu_results = stream.results()
+        # one retry on the plain-XLA kernel if the pallas path failed its
+        # on-chip validation (rc=3) OR crashed before producing any timed
+        # stage (e.g. a Mosaic lowering regression raising at compile) —
+        # degraded throughput with an honest impl field beats no number
+        # (round-2 lesson, now orchestrator-level)
+        validate_failed = any(
+            r.get("chip_validate_ok") is False for r in tpu_results
+        )
+        crashed_early = (
+            not stream.killed
+            and stream.proc.returncode not in (0, None)
+            and not any(
+                r.get("stage", "").startswith("batch-") for r in tpu_results
+            )
+        )
+        if crashed_early:
+            _emit(
+                _result_line(
+                    "tpu-worker-crashed", 0.0,
+                    dict(partial=True, rc=stream.proc.returncode,
+                         stderr=stream.stderr_tail()),
+                )
+            )
+        if (validate_failed or crashed_early) and remaining() > 500.0:
+            env = _worker_env("tpu")
+            env["COMETBFT_TPU_VERIFY_IMPL"] = "xla"
+            retry = _run_tpu_worker(env, remaining)
+            streams.append(retry)
+            tpu_results = tpu_results + retry.results()
+    # Final line selection: prefer the TPU final line; else best TPU
+    # partial; else wait (bounded) for the CPU worker and use its result;
+    # else report failure honestly.
+    final = None
+    for rec in tpu_results:
+        if rec.get("stage") == "final":
+            final = rec
+    if final is None:
+        timed = [r for r in tpu_results if r.get("stage", "").startswith("batch-")]
+        if timed:
+            best = max(timed, key=lambda r: r["value"])
+            final = dict(best)
+            final["stage"] = "final-partial"
+            final["partial"] = True
+    if final is None:
+        while cpu.alive() and remaining() > 30.0:
+            if any(r.get("stage") == "final" for r in cpu.results()):
+                break
+            time.sleep(2.0)
+        for rec in cpu.results():
+            if rec.get("stage") == "final":
+                final = rec
+        if final is None:
+            timed = [
+                r for r in cpu.results()
+                if r.get("stage", "").startswith("batch-")
+            ]
+            if timed:
+                final = dict(max(timed, key=lambda r: r["value"]))
+                final["stage"] = "final-partial"
+                final["partial"] = True
+    for s in streams:
+        s.kill()
+        s.cleanup()
+    if final is None:
+        final = _result_line(
+            "final-failed", 0.0,
+            dict(partial=True, error="no stage completed within budget"),
+        )
+    if final.get("stage") == "final":
+        final.pop("partial", None)
+    _emit(final)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", choices=["tpu", "cpu"])
+    ap.add_argument("--probe", action="store_true")
+    args = ap.parse_args()
+    for k, v in _CACHE_ENV.items():
+        os.environ.setdefault(k, v)
+    if args.probe:
+        probe()
+    elif args.worker:
+        plat = os.environ.get("COMETBFT_TPU_JAX_PLATFORM")
+        worker("cpu" if (plat == "cpu" or args.worker == "cpu") else "tpu")
+    else:
+        orchestrate()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,122 @@
+"""Ahead-of-time executable cache for the verify kernels.
+
+The Mosaic compile of the Pallas verify kernel costs minutes through the
+axon tunnel, and JAX's persistent *compilation* cache alone did not save
+round 3's bench (a wedged tunnel mid-compile leaves nothing cached).  This
+module adds a second, explicit layer: after a successful compile the whole
+PJRT executable is pickled (``jax.experimental.serialize_executable``) to
+disk, keyed by (source fingerprint, jax version, platform, shape tag), and
+later runs load it back without any tracing or compilation at all.
+
+Serialization support is a per-PJRT-plugin capability — every call degrades
+gracefully (``info["exec_cache"]`` says what happened) so a plugin without
+it only loses the optimization, never the run.
+
+Reference analog: none — the reference's Go hot path (crypto/ed25519/
+ed25519.go:189-222) has no compile step to amortize.  This is TPU-runtime
+plumbing in service of SURVEY §3.4's bench story.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+
+import jax
+
+CACHE_DIR = os.environ.get(
+    "COMETBFT_TPU_EXEC_CACHE", os.path.expanduser("~/.cache/cometbft_tpu_exec")
+)
+
+
+# Env vars that select a different TRACE of the same sources (see
+# ops/verify.py _decompress_pair): they must be part of the cache key or a
+# cached executable silently overrides the operator's escape hatch.
+_TRACE_ENV_VARS = ("COMETBFT_TPU_MERGED_DECOMPRESS",)
+
+
+def _fingerprint() -> str:
+    """Hash of the compute-path sources + jax version + trace-affecting env
+    vars: any kernel edit, toolchain bump, or escape-hatch flip invalidates
+    cached executables."""
+    h = hashlib.sha256()
+    d = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(d)):
+        if fn.endswith(".py"):
+            with open(os.path.join(d, fn), "rb") as f:
+                h.update(f.read())
+    h.update(jax.__version__.encode())
+    for var in _TRACE_ENV_VARS:
+        h.update(f"{var}={os.environ.get(var, '')}".encode())
+    return h.hexdigest()[:16]
+
+
+def _path(tag: str, platform: str) -> str:
+    return os.path.join(
+        CACHE_DIR, f"{tag}-{platform}-{_fingerprint()}.jexec"
+    )
+
+
+def load(tag: str):
+    """Load a cached executable for ``tag`` on the current platform.
+
+    Returns (compiled, info) or (None, info)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        platform = jax.devices()[0].platform
+        path = _path(tag, platform)
+    except Exception as e:  # noqa: BLE001 - degrade, never break the run
+        return None, {"exec_cache": f"unsupported:{type(e).__name__}"}
+    if not os.path.exists(path):
+        return None, {"exec_cache": "miss"}
+    try:
+        t0 = time.perf_counter()
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        compiled = se.deserialize_and_load(
+            payload["serialized"], payload["in_tree"], payload["out_tree"]
+        )
+        return compiled, {
+            "exec_cache": "hit",
+            "exec_load_s": round(time.perf_counter() - t0, 3),
+        }
+    except Exception as e:  # noqa: BLE001 - any failure means recompile
+        return None, {"exec_cache": f"stale:{type(e).__name__}"}
+
+
+def store(tag: str, compiled) -> str:
+    """Serialize ``compiled`` under ``tag``; returns a status string."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        platform = jax.devices()[0].platform
+        serialized, in_tree, out_tree = se.serialize(compiled)
+        payload = pickle.dumps(
+            {"serialized": serialized, "in_tree": in_tree,
+             "out_tree": out_tree}
+        )
+    except Exception as e:  # noqa: BLE001 - plugin may not support it
+        return f"unsupported:{type(e).__name__}"
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    path = _path(tag, platform)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    return "written"
+
+
+def load_or_compile(jitted, kwargs: dict, tag: str):
+    """AOT-compile ``jitted`` for the shapes in ``kwargs`` (or load the
+    cached executable).  Returns (call, info): ``call(**kwargs)`` runs the
+    executable; info records cache behavior and compile time."""
+    compiled, info = load(tag)
+    if compiled is None:
+        t0 = time.perf_counter()
+        compiled = jitted.lower(**kwargs).compile()
+        info["compile_s"] = round(time.perf_counter() - t0, 1)
+        info["exec_cache_write"] = store(tag, compiled)
+    return compiled, info

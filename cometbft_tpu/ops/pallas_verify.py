@@ -110,14 +110,16 @@ def _build(batch: int, tile: int):
 
 
 def verify_core_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok,
-                       tile: int = TILE):
+                       tile: int | None = None):
     """Drop-in replacement for ``ops.verify.verify_core`` on TPU.
 
     Same raw-byte signature; unpacking runs in XLA, the heavy pipeline in
     one Pallas kernel tiled over lanes.  Returns (B,) bool accept bits.
+    ``tile`` defaults to the module's TILE (read at call time so tests and
+    sweeps can adjust it).
     """
     batch = a_bytes.shape[0]
-    tile = min(tile, batch)
+    tile = min(tile or TILE, batch)
     pad = (-batch) % tile
     if pad:
         # pad to a tile multiple with s_ok=0 lanes (rejected by

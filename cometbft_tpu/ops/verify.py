@@ -101,20 +101,28 @@ def _decompress_pair(ya, sa, yr, sr):
 _verify_kernel = jax.jit(verify_core)
 
 
-def _use_pallas() -> bool:
-    """Pallas kernel on real TPU hardware; plain-XLA everywhere else (CPU
-    tests, virtual meshes).  COMETBFT_TPU_VERIFY_IMPL=pallas|xla overrides."""
+def select_impl(devices=None) -> str:
+    """Kernel selection — THE seam shared by the single-chip path
+    (``verify_batch``) and the mesh-sharded path (``parallel.mesh``), so
+    the flagship features always compose: Pallas on real TPU devices,
+    plain-XLA everywhere else (CPU tests, virtual meshes).
+    COMETBFT_TPU_VERIFY_IMPL=pallas|xla overrides."""
     import os
 
     env = os.environ.get("COMETBFT_TPU_VERIFY_IMPL")
-    if env == "pallas":
-        return True
-    if env == "xla":
-        return False
+    if env in ("pallas", "xla"):
+        return env
     try:
-        return jax.devices()[0].platform == "tpu"
+        devs = list(devices) if devices is not None else jax.devices()
+        if devs and all(d.platform == "tpu" for d in devs):
+            return "pallas"
     except Exception:
-        return False
+        pass
+    return "xla"
+
+
+def _use_pallas() -> bool:
+    return select_impl() == "pallas"
 
 
 @jax.jit

@@ -41,10 +41,19 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), (SIG_AXIS,))
 
 
-def _verify_shard(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
-    """Per-device body: verify the local shard, contribute to the global
-    accept count via one psum (the only collective)."""
-    accept = ov.verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok)
+def _verify_shard(a_bytes, r_bytes, s_bytes, m_bytes, s_ok, *, impl: str):
+    """Per-device body: verify the local shard through the SAME kernel the
+    single-chip path selects (Pallas on TPU meshes, XLA elsewhere —
+    ``ops.verify.select_impl``), contribute to the global accept count via
+    one psum (the only collective)."""
+    if impl == "pallas":
+        from cometbft_tpu.ops import pallas_verify
+
+        accept = pallas_verify.verify_core_pallas(
+            a_bytes, r_bytes, s_bytes, m_bytes, s_ok
+        )
+    else:
+        accept = ov.verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok)
     n_ok = jax.lax.psum(jnp.sum(accept.astype(jnp.int32)), SIG_AXIS)
     return accept, n_ok
 
@@ -52,18 +61,19 @@ def _verify_shard(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
 _FN_CACHE: dict = {}
 
 
-def sharded_verify_fn(mesh: Mesh):
+def sharded_verify_fn(mesh: Mesh, impl: Optional[str] = None):
     """jit-compiled mesh-sharded verifier.  Inputs are the packed batch arrays
     from ``ops.verify.prepare_batch`` padded to a multiple of the mesh size;
-    limb arrays are (20, B) / bit arrays (253, B) sharded on the batch (lane)
-    axis, scalars (B,) sharded likewise."""
-    key = tuple((d.platform, d.id) for d in mesh.devices.flat)
+    raw byte arrays are (B, 32) sharded on the batch (lane) axis, scalars
+    (B,) sharded likewise.  ``impl`` overrides kernel selection (tests)."""
+    impl = impl or ov.select_impl(mesh.devices.flat)
+    key = (impl,) + tuple((d.platform, d.id) for d in mesh.devices.flat)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
     batch_first = NamedSharding(mesh, P(SIG_AXIS, None))
     vec = NamedSharding(mesh, P(SIG_AXIS))
     fn = shard_map(
-        _verify_shard,
+        partial(_verify_shard, impl=impl),
         mesh=mesh,
         in_specs=(
             P(SIG_AXIS, None),  # a_bytes (B, 32)

@@ -3,14 +3,20 @@
 These guard the driver's ``dryrun_multichip`` path (MULTICHIP_r01 failed
 because arrays were materialized on the default device before resharding) —
 the full sharded verify must compile AND execute hermetically on whatever
-mesh it is given.
+mesh it is given.  They also pin the kernel-selection seam: the sharded
+path must route through the SAME impl choice as the single-chip path
+(VERDICT r3 #3 — the two flagship features were never composed).
 """
+
+import os
 
 import numpy as np
 import jax
+import pytest
 
 import __graft_entry__ as graft
 from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import verify as ov
 from cometbft_tpu.parallel import mesh as pmesh
 
 
@@ -37,3 +43,80 @@ class TestMeshVerify:
         expected[[3, 11, 17]] = False
         assert bits.shape == (n,)
         assert (bits == expected).all()
+
+
+class TestKernelSelectionSeam:
+    """The mesh path and the single-chip path share ``select_impl``."""
+
+    def test_env_override_reaches_mesh(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_VERIFY_IMPL", "pallas")
+        assert ov.select_impl(jax.devices("cpu")[:2]) == "pallas"
+        monkeypatch.setenv("COMETBFT_TPU_VERIFY_IMPL", "xla")
+        assert ov.select_impl(jax.devices("cpu")[:2]) == "xla"
+
+    def test_cpu_mesh_defaults_to_xla(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_VERIFY_IMPL", raising=False)
+        assert ov.select_impl(jax.devices("cpu")[:2]) == "xla"
+        # tpu-looking devices select pallas — same predicate verify_batch uses
+        class FakeTpu:
+            platform = "tpu"
+
+        assert ov.select_impl([FakeTpu(), FakeTpu()]) == "pallas"
+        assert ov.select_impl([FakeTpu(), jax.devices("cpu")[0]]) == "xla"
+
+    def test_fn_cache_keyed_on_impl(self):
+        mesh = pmesh.make_mesh(jax.devices("cpu")[:2])
+        fn_xla = pmesh.sharded_verify_fn(mesh, impl="xla")
+        assert pmesh.sharded_verify_fn(mesh, impl="xla") is fn_xla
+        key_xla = ("xla",) + tuple(
+            (d.platform, d.id) for d in mesh.devices.flat
+        )
+        assert key_xla in pmesh._FN_CACHE
+
+
+@pytest.mark.skipif(
+    not os.environ.get("COMETBFT_TPU_SLOW_TESTS"),
+    reason="interpret-mode Pallas is minutes-slow; set COMETBFT_TPU_SLOW_TESTS=1",
+)
+class TestMeshPallasComposition:
+    """The real composition: a sharded verify whose per-shard body is the
+    Pallas kernel, executed in interpret mode on a CPU mesh."""
+
+    def test_sharded_pallas_interpret(self, monkeypatch):
+        from jax.experimental import pallas as pl
+
+        import cometbft_tpu.ops.pallas_verify as pv
+
+        orig = pl.pallas_call
+
+        def patched(*args, **kwargs):
+            kwargs.setdefault("interpret", True)
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(pl, "pallas_call", patched)
+        monkeypatch.setattr(pv, "TILE", 8)
+        pv._build.cache_clear()
+        pmesh._FN_CACHE.clear()
+        try:
+            mesh = pmesh.make_mesh(jax.devices("cpu")[:2])
+            pubs, msgs, sigs = [], [], []
+            n = 16
+            for i in range(n):
+                seed = bytes([i + 1]) * 32
+                pubs.append(ref.pubkey_from_seed(seed))
+                msgs.append(b"compose-%d" % i)
+                sigs.append(ref.sign(seed, msgs[-1]))
+            sigs[5] = bytes(64)
+            msgs[9] = b"tampered"
+            arrays, _, structural = ov.prepare_batch(pubs, msgs, sigs)
+            arrays = pmesh.pad_to_mesh(arrays, mesh)
+            fn, _ = pmesh.sharded_verify_fn(mesh, impl="pallas")
+            accept, n_ok = fn(*pmesh.device_put_args(arrays, mesh))
+            bits = (np.asarray(accept)[: len(structural)] & structural)[:n]
+            expected = np.ones(n, bool)
+            expected[[5, 9]] = False
+            assert (bits == expected).all()
+            assert int(n_ok) == n - 2
+        finally:
+            pv._build.cache_clear()
+            pmesh._FN_CACHE.clear()
