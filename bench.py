@@ -277,7 +277,7 @@ def worker(platform_mode: str) -> None:
     )
     if 10240 in stage_s:
         extra["commit10k_ms"] = round(stage_s[10240] * 1e3, 3)
-    b1, b2 = batches[-2], batches[-1]
+    b1, b2 = (batches[-2], batches[-1]) if len(batches) >= 2 else (0, 0)
     if b2 > b1:
         slope = (stage_s[b2] - stage_s[b1]) / (b2 - b1)
         extra["commit10k_device_est_ms"] = round(max(slope, 0.0) * 10240 * 1e3, 3)

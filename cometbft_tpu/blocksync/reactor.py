@@ -375,11 +375,14 @@ def check_ext_commit(
             chain_id, ec.height, ec.round_, cs.extension
         )
         entries.append((vals[i].pub_key, msg, cs.extension_signature))
-    # batch when every key supports it (same discipline as
-    # validation._verify_commit); per-signature fallback otherwise —
-    # secp256k1/bls12_381 validators must not stall blocksync
-    if len(entries) >= 2 and all(
-        cbatch.supports_batch_verifier(pk) for pk, _, _ in entries
+    # batch when every key supports it AND the key type is homogeneous
+    # (same discipline as validation._should_batch — one batch verifier
+    # handles one key type); per-signature fallback otherwise, so mixed or
+    # secp256k1 validator sets must not stall blocksync
+    if (
+        len(entries) >= 2
+        and len({getattr(pk, "type_", None) for pk, _, _ in entries}) == 1
+        and all(cbatch.supports_batch_verifier(pk) for pk, _, _ in entries)
     ):
         bv = cbatch.create_batch_verifier(entries[0][0])
         for pk, msg, sig in entries:

@@ -126,12 +126,24 @@ class P2PConfig:
     allow_duplicate_ip: bool = False
     handshake_timeout_s: int = 20
     dial_timeout_s: int = 3
+    # WAN latency emulation (testnets): this node's zone, the zone-pair RTT
+    # matrix (ms), and each peer id's zone.  Empty zone = no emulation.
+    # Reference analog: test/e2e/pkg/latency/ zone tables applied via tc.
+    zone: str = ""
+    zone_rtt_ms: dict = field(default_factory=dict)
+    peer_zones: dict = field(default_factory=dict)
 
     def validate_basic(self) -> Optional[str]:
         if self.max_packet_msg_payload_size <= 0:
             return "max_packet_msg_payload_size must be positive"
         if self.send_rate < 0 or self.recv_rate < 0:
             return "send_rate/recv_rate cannot be negative"
+        for a, row in (self.zone_rtt_ms or {}).items():
+            if not isinstance(row, dict):
+                return f"zone_rtt_ms[{a!r}] must be a table of rtt values"
+            for b, v in row.items():
+                if not isinstance(v, (int, float)) or v < 0:
+                    return f"zone_rtt_ms[{a!r}][{b!r}] must be a nonneg number"
         return None
 
 
@@ -382,6 +394,16 @@ def _toml_value(v) -> str:
         return '"' + s + '"'
     if isinstance(v, list):
         return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    if isinstance(v, dict):
+        # inline table; keys always quoted (zone names, node ids)
+        return (
+            "{"
+            + ", ".join(
+                f"{_toml_value(str(k))} = {_toml_value(x)}"
+                for k, x in v.items()
+            )
+            + "}"
+        )
     raise TypeError(f"unsupported TOML value: {type(v)}")
 
 

@@ -144,15 +144,164 @@ class TpuBatchVerifier(_CollectingVerifier):
         return all(bits), bits
 
 
+_BLS_DEVICE_OK: Optional[bool] = None
+
+
+def _bls_device_ok() -> bool:
+    """Lazy gate for the TPU G1 path inside BLS batch verification: an
+    accelerator must be visible AND a known-answer scalar-mul must match
+    the host oracle before consensus trusts it (same discipline as
+    ``_tpu_self_check``).  COMETBFT_TPU_BLS_DEVICE=1/0 forces."""
+    global _BLS_DEVICE_OK
+    env = os.environ.get("COMETBFT_TPU_BLS_DEVICE")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    with _LOCK:
+        if _BLS_DEVICE_OK is None:
+            try:
+                import jax
+
+                if jax.devices()[0].platform == "cpu":
+                    # XLA-CPU runs the limb kernels orders of magnitude
+                    # slower than host bigints — device path is TPU-only
+                    _BLS_DEVICE_OK = False
+                else:
+                    from cometbft_tpu.crypto import bls12381 as bls
+                    from cometbft_tpu.ops import bls_g1 as g1
+
+                    gen = bls.E1.affine(bls.G1_GEN)
+                    got = g1.batch_scalar_mul([gen], [0x1234], nbits=16)[0]
+                    want = bls.E1.affine(
+                        bls.E1.mul_scalar(bls.G1_GEN, 0x1234)
+                    )
+                    _BLS_DEVICE_OK = got == want
+                    if not _BLS_DEVICE_OK:
+                        logging.getLogger("cometbft_tpu.crypto").error(
+                            "TPU BLS G1 backend FAILED its known-answer "
+                            "self-check - using host arithmetic"
+                        )
+            except Exception:
+                _BLS_DEVICE_OK = False
+        return _BLS_DEVICE_OK
+
+
+class BlsBatchVerifier(_CollectingVerifier):
+    """Random-linear-combination batch verification for bls12_381.
+
+    Check (basic scheme, per-vote distinct messages NOT required):
+
+        e(G1, Σ rᵢ·Sᵢ)  ==  Π e(rᵢ·pkᵢ, H(mᵢ)),   rᵢ random 128-bit
+
+    which costs n+1 Miller loops + ONE final exponentiation instead of the
+    2n + n of sequential verifies.  The rᵢ·pkᵢ multi-scalar-mul runs on
+    the TPU G1 kernel (ops/bls_g1) when the accelerator passes its
+    self-check; G2 scalar work and the pairing product stay on the host
+    (SURVEY §2.1.1 allows host pairing — one pair per batch after MSM).
+    A failed combination falls back to per-signature verification for
+    attribution, mirroring the reference's recheck pass
+    (types/validation.go:308-317; key type crypto/bls12381/key_bls12381.go:
+    160-188).
+
+    ``backend='cpu'`` (the operator's accelerator kill-switch — config
+    crypto.backend / COMETBFT_TPU_CRYPTO_BACKEND) pins the scalar-mul work
+    to the host regardless of the device self-check."""
+
+    def __init__(self, backend: Optional[str] = None):
+        super().__init__()
+        self._backend = backend
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        import secrets
+
+        from cometbft_tpu.crypto import bls12381 as bls
+
+        n = len(self.pubs)
+        if n == 0:
+            return False, []
+        bits = [False] * n
+        entries = []  # (index, pk_jac, h_jac, sig_jac)
+        for i in range(n):
+            pub, msg, sig = self.pubs[i], self.msgs[i], self.sigs[i]
+            if len(pub) != bls.PUB_KEY_SIZE or len(sig) != bls.SIGNATURE_SIZE:
+                continue
+            pk = bls.g1_deserialize(pub)
+            if pk is None or bls.E1.is_infinity(pk) or not bls._g1_subgroup(pk):
+                continue
+            s = bls.g2_uncompress(sig)
+            if s is None or not bls._g2_subgroup(s):
+                continue
+            entries.append((i, pk, bls.hash_to_g2(msg), s))
+        if not entries:
+            return False, bits
+        if len(entries) == 1:
+            i, _, _, _ = entries[0]
+            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
+            return all(bits), bits
+
+        rs = [secrets.randbits(128) | 1 for _ in entries]
+        scaled = self._scaled_pubkeys(
+            [e[1] for e in entries], rs, self._backend
+        )
+        agg = bls.E2.infinity()
+        for (_, _, _, s), r in zip(entries, rs):
+            agg = bls.E2.add_pts(agg, bls.E2.mul_scalar(s, r))
+        pairs = [
+            (bls.E1.neg_pt(rpk), h)
+            for rpk, (_, _, h, _) in zip(scaled, entries)
+        ]
+        pairs.append((bls.G1_GEN, agg))
+        if bls._pairing_product_is_one(pairs):
+            for i, _, _, _ in entries:
+                bits[i] = True
+            return all(bits), bits
+        # attribution fallback: the combination failed, find the culprits
+        for i, _, _, _ in entries:
+            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
+        return all(bits), bits
+
+    @staticmethod
+    def _scaled_pubkeys(pks, rs, backend: Optional[str] = None):
+        """[rᵢ·pkᵢ] as jacobian host points; TPU kernel when trusted and
+        not disabled by the backend kill-switch."""
+        from cometbft_tpu.crypto import bls12381 as bls
+
+        if backend != "cpu" and _bls_device_ok():
+            try:
+                from cometbft_tpu.ops import bls_g1 as g1
+
+                affs = [bls.E1.affine(pk) for pk in pks]
+                out = g1.batch_scalar_mul(affs, rs, nbits=128)
+                return [
+                    bls.E1.infinity() if a is None else (a[0], a[1], 1)
+                    for a in out
+                ]
+            except Exception:
+                logging.getLogger("cometbft_tpu.crypto").exception(
+                    "TPU BLS G1 path raised - host fallback"
+                )
+        return [bls.E1.mul_scalar(pk, r) for pk, r in zip(pks, rs)]
+
+
 def supports_batch_verifier(pub_key) -> bool:
-    """Reference: crypto/batch/batch.go:21."""
-    return getattr(pub_key, "type_", None) == ck.ED25519_KEY_TYPE
+    """Reference: crypto/batch/batch.go:21 — ed25519 there; bls12_381 joins
+    via the aggregate path (key_bls12381.go:160-188)."""
+    return getattr(pub_key, "type_", None) in (
+        ck.ED25519_KEY_TYPE,
+        ck.BLS12381_KEY_TYPE,
+    )
 
 
 def create_batch_verifier(pub_key, backend: Optional[str] = None) -> BatchVerifier:
     """Reference: crypto/batch/batch.go:10."""
     if not supports_batch_verifier(pub_key):
         raise ValueError(f"key type does not support batch verification: {pub_key}")
+    if getattr(pub_key, "type_", None) == ck.BLS12381_KEY_TYPE:
+        env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
+        if (backend is None or backend == "auto") and env and env != "auto":
+            backend = env
+        return BlsBatchVerifier(backend=backend)
     if backend is None or backend == "auto":
         backend = default_backend()
     if backend == "tpu":

@@ -306,11 +306,21 @@ class Node(BaseService):
             moniker=config.base.moniker,
             rpc_address=config.rpc.laddr,
         )
+        latency = None
+        if config.p2p.zone:
+            from cometbft_tpu.p2p.latency import ZoneMatrix
+
+            latency = (
+                config.p2p.zone,
+                ZoneMatrix.from_config(config.p2p.zone_rtt_ms),
+                dict(config.p2p.peer_zones or {}),
+            )
         transport = Transport(
             self.node_key,
             lambda: self._node_info,
             handshake_timeout=config.p2p.handshake_timeout_s,
             dial_timeout=config.p2p.dial_timeout_s,
+            latency=latency,
         )
         self.switch = Switch(
             config.p2p,

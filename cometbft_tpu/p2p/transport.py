@@ -49,12 +49,14 @@ class Transport:
         handshake_timeout: float = 20.0,
         dial_timeout: float = 3.0,
         conn_wrapper: Optional[Callable] = None,  # e.g. FuzzedConnection
+        latency: Optional[tuple] = None,  # (my_zone, ZoneMatrix, peer_zones)
     ):
         self.node_key = node_key
         self.node_info_fn = node_info_fn
         self.handshake_timeout = handshake_timeout
         self.dial_timeout = dial_timeout
         self.conn_wrapper = conn_wrapper
+        self.latency = latency if latency and latency[0] else None
         self._listener: Optional[socket.socket] = None
         self.listen_addr: Optional[tuple[str, int]] = None
         self._closed = threading.Event()
@@ -106,6 +108,13 @@ class Transport:
         self, sock: socket.socket, addr, outbound: bool, expected_id: str
     ) -> UpgradedConn:
         sock.settimeout(self.handshake_timeout)
+        delayed = None
+        if self.latency is not None:
+            # innermost wrapper: emulated WAN delay applies to the final
+            # bytes; armed after the handshake identifies the peer's zone
+            from cometbft_tpu.p2p.latency import DelayedSocket
+
+            sock = delayed = DelayedSocket(sock)
         if self.conn_wrapper is not None:
             sock = self.conn_wrapper(sock)
         try:
@@ -124,6 +133,10 @@ class Transport:
                     "peer's claimed node id does not match its handshake key"
                 )
             self.node_info_fn().compatible_with(their_info)
+            if delayed is not None:
+                my_zone, matrix, peer_zones = self.latency
+                peer_zone = peer_zones.get(their_info.node_id, "")
+                delayed.set_delay(matrix.one_way_s(my_zone, peer_zone))
             # back to blocking IO for the MConnection routines
             try:
                 sock.settimeout(None)

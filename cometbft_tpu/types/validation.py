@@ -58,9 +58,13 @@ def _verify_basic(vals: ValidatorSet, commit: Commit, height: int, block_id: Blo
 
 def _should_batch(vals: ValidatorSet, commit: Commit) -> bool:
     """Reference: types/validation.go:15 shouldBatchVerify — >=2 signatures
-    and a batch-capable homogeneous key type."""
+    and a batch-capable HOMOGENEOUS key type (a batch verifier handles one
+    key type; a mixed ed25519/bls set must fall back to per-signature)."""
     non_absent = sum(0 if cs.absent() else 1 for cs in commit.signatures)
     if non_absent < 2:
+        return False
+    types = {getattr(v.pub_key, "type_", None) for v in vals.validators}
+    if len(types) != 1:
         return False
     return all(cbatch.supports_batch_verifier(v.pub_key) for v in vals.validators)
 

@@ -24,6 +24,7 @@ class NodeManifest:
     state_sync: bool = False
     start_at: int = 0  # join at this height (0 = from genesis)
     perturb: list = field(default_factory=list)  # kill|pause|restart|disconnect
+    zone: str = ""  # latency-emulation zone (see Manifest.zones)
 
 
 @dataclass
@@ -34,6 +35,10 @@ class Manifest:
     load_tx_bytes: int = 256
     wait_height: int = 6  # target height for the run phase
     nodes: list = field(default_factory=list)
+    # zone-pair RTT matrix (ms) for WAN latency emulation — the reference's
+    # tc-based zone tables (test/e2e/pkg/latency/); applied per-link by the
+    # transport's DelayedSocket when nodes declare a zone
+    zones: dict = field(default_factory=dict)
 
     @property
     def validators(self):
@@ -54,6 +59,12 @@ class Manifest:
                     raise ValueError(f"{n.name}: bad perturbation {p!r}")
         if not any(n.mode == "validator" for n in self.nodes):
             raise ValueError("manifest has no validators")
+        known_zones = set(self.zones)
+        for row in self.zones.values():
+            known_zones.update(row)
+        for n in self.nodes:
+            if n.zone and n.zone not in known_zones:
+                raise ValueError(f"{n.name}: unknown zone {n.zone!r}")
 
 
 def load_manifest(path: str) -> Manifest:
@@ -65,6 +76,10 @@ def load_manifest(path: str) -> Manifest:
         load_tx_rate=doc.get("load_tx_rate", 20),
         load_tx_bytes=doc.get("load_tx_bytes", 256),
         wait_height=doc.get("wait_height", 6),
+        zones={
+            str(a): {str(b): float(v) for b, v in row.items()}
+            for a, row in doc.get("zones", {}).items()
+        },
     )
     for name, nd in sorted(doc.get("node", {}).items()):
         m.nodes.append(
@@ -76,6 +91,7 @@ def load_manifest(path: str) -> Manifest:
                 state_sync=nd.get("state_sync", False),
                 start_at=nd.get("start_at", 0),
                 perturb=list(nd.get("perturb", [])),
+                zone=nd.get("zone", ""),
             )
         )
     m.validate()

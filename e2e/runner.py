@@ -99,6 +99,10 @@ class Testnet:
             cfg.base.db_backend = "sqlite"  # must survive kill -9
             cfg.consensus.timeout_commit_ms = 200
             cfg.consensus.timeout_propose_ms = 2000
+            if nm.zone:
+                cfg.p2p.zone = nm.zone
+                cfg.p2p.zone_rtt_ms = self.manifest.zones
+                # peer_zones is filled in the second pass (node ids below)
             if nm.abci_protocol in ("socket", "grpc"):
                 app_port = _free_port()
                 cfg.base.abci = nm.abci_protocol
@@ -152,6 +156,12 @@ class Testnet:
             cfg.p2p.persistent_peers = [
                 p for j, p in enumerate(peers) if j != i
             ]
+            if cfg.p2p.zone:
+                cfg.p2p.peer_zones = {
+                    n.node_id: n.manifest.zone
+                    for n in self.nodes
+                    if n.manifest.zone and n.node_id != node.node_id
+                }
             cfgmod.write_config(cfg)
 
     # -- start / stop -----------------------------------------------------

@@ -1,0 +1,105 @@
+"""Differential tests: ops.fp381 (Montgomery limb field) vs python bigints.
+
+Mirrors tests/test_fe25519.py's role for the 25519 field: every ring op is
+pinned against exact integer arithmetic mod P381, including the lazy-bound
+chains that exercise the trace-time interval analysis.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu.ops import fp381 as fp
+
+P = fp.P_INT
+
+
+@pytest.fixture(scope="module")
+def vals():
+    rng = random.Random(0xB15)
+    a = [0, 1, P - 1, 2, P - 2] + [rng.randrange(P) for _ in range(11)]
+    b = [1, 0, P - 1, P - 1, 7] + [rng.randrange(P) for _ in range(11)]
+    return a, b
+
+
+class TestFp381:
+    def test_montgomery_constants(self):
+        assert (fp.P_INT * fp.NPRIME) % fp.R_INT == fp.R_INT - 1
+        assert (fp.R_INT * fp.R_INV) % P == 1
+
+    def test_pack_unpack_roundtrip(self, vals):
+        a, _ = vals
+        assert fp.unpack(fp.pack(a)) == [v % P for v in a]
+
+    def test_mul(self, vals):
+        a, b = vals
+        got = fp.unpack(fp.mul(fp.pack(a), fp.pack(b)))
+        assert got == [(x * y) % P for x, y in zip(a, b)]
+
+    def test_square(self, vals):
+        a, _ = vals
+        assert fp.unpack(fp.square(fp.pack(a))) == [x * x % P for x in a]
+
+    def test_add_sub_neg(self, vals):
+        a, b = vals
+        fa, fb = fp.pack(a), fp.pack(b)
+        assert fp.unpack(fp.add(fa, fb)) == [(x + y) % P for x, y in zip(a, b)]
+        assert fp.unpack(fp.sub(fa, fb)) == [(x - y) % P for x, y in zip(a, b)]
+        assert fp.unpack(fp.neg(fa)) == [(-x) % P for x in a]
+
+    def test_lazy_chain(self, vals):
+        """Sums feed the multiplier unreduced; bounds force auto-carries."""
+        a, b = vals
+        fa, fb = fp.pack(a), fp.pack(b)
+        got = fp.unpack(fp.mul(fp.add(fa, fb), fp.sub(fa, fp.neg(fb))))
+        assert got == [((x + y) * (x + y)) % P for x, y in zip(a, b)]
+
+    def test_deep_chain(self, vals):
+        """20 rounds of (x+b)^2 — value/limb bounds must stay at fixpoint."""
+        a, b = vals
+        d, fb = fp.pack(a), fp.pack(b)
+        e = list(a)
+        for _ in range(20):
+            d = fp.square(fp.add(d, fb))
+            e = [((x + y) ** 2) % P for x, y in zip(e, b)]
+        assert fp.unpack(d) == e
+
+    def test_mul_small(self, vals):
+        a, _ = vals
+        assert fp.unpack(fp.mul_small(fp.pack(a), 12)) == [
+            (12 * x) % P for x in a
+        ]
+
+
+class TestFp2:
+    def test_mul_square(self):
+        rng = random.Random(0xF2)
+        xs = [(rng.randrange(P), rng.randrange(P)) for _ in range(8)]
+        ys = [(rng.randrange(P), rng.randrange(P)) for _ in range(8)]
+
+        def ref_mul(x, y):
+            return (
+                (x[0] * y[0] - x[1] * y[1]) % P,
+                (x[0] * y[1] + x[1] * y[0]) % P,
+            )
+
+        x2, y2 = fp.f2_pack(xs), fp.f2_pack(ys)
+        assert fp.f2_unpack(fp.f2_mul(x2, y2)) == [
+            ref_mul(x, y) for x, y in zip(xs, ys)
+        ]
+        assert fp.f2_unpack(fp.f2_square(x2)) == [ref_mul(x, x) for x in xs]
+
+    def test_add_sub_neg(self):
+        rng = random.Random(0xF3)
+        xs = [(rng.randrange(P), rng.randrange(P)) for _ in range(4)]
+        ys = [(rng.randrange(P), rng.randrange(P)) for _ in range(4)]
+        x2, y2 = fp.f2_pack(xs), fp.f2_pack(ys)
+        assert fp.f2_unpack(fp.f2_add(x2, y2)) == [
+            ((x[0] + y[0]) % P, (x[1] + y[1]) % P) for x, y in zip(xs, ys)
+        ]
+        assert fp.f2_unpack(fp.f2_sub(x2, y2)) == [
+            ((x[0] - y[0]) % P, (x[1] - y[1]) % P) for x, y in zip(xs, ys)
+        ]
+        assert fp.f2_unpack(fp.f2_neg(x2)) == [
+            ((-x[0]) % P, (-x[1]) % P) for x in xs
+        ]
