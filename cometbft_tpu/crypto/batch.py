@@ -220,6 +220,9 @@ class BlsBatchVerifier(_CollectingVerifier):
         n = len(self.pubs)
         if n == 0:
             return False, []
+        lib = bls._nat()
+        if lib is not None:
+            return self._verify_native(lib)
         bits = [False] * n
         entries = []  # (index, pk_jac, h_jac, sig_jac)
         for i in range(n):
@@ -258,6 +261,85 @@ class BlsBatchVerifier(_CollectingVerifier):
             return all(bits), bits
         # attribution fallback: the combination failed, find the culprits
         for i, _, _, _ in entries:
+            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
+        return all(bits), bits
+
+    def _verify_native(self, lib) -> tuple[bool, list[bool]]:
+        """RLC batch verification with every host-side group/pairing op in
+        the native library; the TPU G1 MSM still handles the rᵢ·pkᵢ
+        multi-scalar-mul when the device passes its self-check.  Same
+        check and attribution semantics as the pure-Python path."""
+        import ctypes
+        import secrets
+
+        from cometbft_tpu.crypto import bls12381 as bls
+
+        n = len(self.pubs)
+        bits = [False] * n
+        entries = []  # index of each structurally-valid (pub, msg, sig)
+        for i in range(n):
+            pub, sig = self.pubs[i], self.sigs[i]
+            if len(pub) != bls.PUB_KEY_SIZE or len(sig) != bls.SIGNATURE_SIZE:
+                continue
+            if lib.bls_pubkey_validate(pub, len(pub)) != 1:
+                continue
+            if lib.bls_sig_validate(sig) != 1:
+                continue
+            entries.append(i)
+        if not entries:
+            return False, bits
+        if len(entries) == 1:
+            i = entries[0]
+            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
+            return all(bits), bits
+
+        rs = [secrets.randbits(128) | 1 for _ in entries]
+        r_bytes = [r.to_bytes(16, "big") for r in rs]
+
+        # rᵢ·pkᵢ — TPU MSM when trusted, else native scalar mul
+        g1_parts = []
+        if self._backend != "cpu" and _bls_device_ok():
+            pks = [bls.g1_deserialize(self.pubs[i]) for i in entries]
+            for pt in self._scaled_pubkeys(pks, rs, self._backend):
+                g1_parts.append(bls.g1_serialize(bls.E1.neg_pt(pt)))
+        else:
+            for i, rb in zip(entries, r_bytes):
+                out = ctypes.create_string_buffer(96)
+                if lib.bls_g1_scalar_mul(self.pubs[i], rb, 16, out) != 0:
+                    return False, bits
+                g1_parts.append(bls.g1_negate_serialized(out.raw))
+
+        # Σ rᵢ·Sᵢ and H(mᵢ), all native
+        scaled_sigs = []
+        hashes = []
+        for i, rb in zip(entries, r_bytes):
+            so = ctypes.create_string_buffer(96)
+            if lib.bls_g2_scalar_mul_compressed(self.sigs[i], rb, 16, so) != 0:
+                return False, bits
+            scaled_sigs.append(so.raw)
+            ho = ctypes.create_string_buffer(96)
+            msg = self.msgs[i]
+            if lib.bls_hash_to_g2(msg, len(msg), ho) != 0:
+                return False, bits
+            hashes.append(ho.raw)
+        agg = ctypes.create_string_buffer(96)
+        if lib.bls_aggregate_sigs(
+            b"".join(scaled_sigs), len(scaled_sigs), agg
+        ) != 0:
+            return False, bits
+
+        from cometbft_tpu.crypto.bls12381 import G1_GEN, g1_serialize
+
+        g1cat = b"".join(g1_parts) + g1_serialize(G1_GEN)
+        g2cat = b"".join(hashes) + agg.raw
+        if lib.bls_pairing_product_is_one_serialized(
+            g1cat, g2cat, len(entries) + 1
+        ) == 1:
+            for i in entries:
+                bits[i] = True
+            return all(bits), bits
+        # attribution fallback: the combination failed, find the culprits
+        for i in entries:
             bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
         return all(bits), bits
 

@@ -546,6 +546,16 @@ def g1_serialize(pt) -> bytes:
     return out
 
 
+def g1_negate_serialized(pub: bytes) -> bytes:
+    """-P over the 96-byte uncompressed encoding (flip y; pure bytes, no
+    curve arithmetic — used to feed negated terms to the native pairing
+    product)."""
+    if pub[0] & 0x40:  # infinity
+        return pub
+    y = int.from_bytes(pub[48:], "big")
+    return pub[:48] + ((P - y) % P).to_bytes(48, "big")
+
+
 def g1_deserialize(b: bytes):
     """Uncompressed or compressed G1 with ZCash flags; returns Jacobian or
     None.  On-curve is checked; subgroup is NOT (callers decide)."""
@@ -730,11 +740,38 @@ def _iso_map(x, y):
 
 def hash_to_g2(msg: bytes, dst: bytes = DST):
     """hash_to_curve for G2 (random oracle variant), returns Jacobian."""
+    if dst == DST:
+        lib = _nat()
+        if lib is not None:
+            import ctypes
+
+            out = ctypes.create_string_buffer(96)
+            if lib.bls_hash_to_g2(msg, len(msg), out) == 0:
+                pt = g2_uncompress(out.raw)
+                if pt is not None:
+                    return pt
     u0, u1 = _hash_to_field_fp2(msg, 2, dst)
     q0 = _iso_map(*_sswu_map(u0))
     q1 = _iso_map(*_sswu_map(u1))
     s = E2.add_pts((q0[0], q0[1], F2_ONE), (q1[0], q1[1], F2_ONE))
     return E2.mul_scalar(s, H_EFF_G2)
+
+
+# ---------------------------------------------------------------------------
+# Native (C++) fast path — the blst analog (SURVEY §2.1.1).  The public
+# API functions below dispatch to cometbft_tpu/native/csrc/bls12381.cpp
+# when it builds and passes its pairing self-check; every operation keeps
+# this module's big-int implementation as the oracle fallback, and
+# tests/test_bls_native.py differential-tests the two.  Kill-switch:
+# COMETBFT_TPU_NO_NATIVE=1 (same switch as the WAL/packer sidecar).
+# ---------------------------------------------------------------------------
+
+
+def _nat():
+    """The native BLS library or None; isolated for test monkeypatching."""
+    from cometbft_tpu import native
+
+    return native.bls()
 
 
 # ---------------------------------------------------------------------------
@@ -791,11 +828,21 @@ def sk_from_bytes(b: bytes) -> Optional[int]:
 
 def pubkey(sk: int) -> bytes:
     """96-byte uncompressed G1 (reference PubKey.Bytes)."""
+    lib = _nat()
+    if lib is not None:
+        import ctypes
+
+        out = ctypes.create_string_buffer(96)
+        if lib.bls_pubkey_from_sk(sk.to_bytes(32, "big"), out) == 0:
+            return out.raw
     return g1_serialize(E1.mul_scalar(G1_GEN, sk))
 
 
 def pubkey_validate(pub: bytes) -> bool:
     """KeyValidate: on curve, in subgroup, not infinity."""
+    lib = _nat()
+    if lib is not None:
+        return lib.bls_pubkey_validate(pub, len(pub)) == 1
     pt = g1_deserialize(pub)
     if pt is None or E1.is_infinity(pt):
         return False
@@ -804,11 +851,21 @@ def pubkey_validate(pub: bytes) -> bool:
 
 def sign(sk: int, msg: bytes) -> bytes:
     """96-byte compressed G2: sk * H(msg)."""
+    lib = _nat()
+    if lib is not None:
+        import ctypes
+
+        out = ctypes.create_string_buffer(96)
+        if lib.bls_sign(sk.to_bytes(32, "big"), msg, len(msg), out) == 0:
+            return out.raw
     return g2_compress(E2.mul_scalar(hash_to_g2(msg), sk))
 
 
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """Reference VerifySignature semantics (key_bls12381.go:174-188)."""
+    lib = _nat()
+    if lib is not None and len(sig) == SIGNATURE_SIZE:
+        return lib.bls_verify(pub, len(pub), msg, len(msg), sig) == 1
     pk = g1_deserialize(pub)
     if pk is None or E1.is_infinity(pk) or not _g1_subgroup(pk):
         return False
@@ -827,6 +884,16 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
 
 def aggregate_signatures(sigs: Sequence[bytes]) -> Optional[bytes]:
     """Sum of G2 signatures (basic scheme aggregation)."""
+    lib = _nat()
+    if lib is not None and sigs and all(
+        len(s) == SIGNATURE_SIZE for s in sigs
+    ):
+        import ctypes
+
+        out = ctypes.create_string_buffer(96)
+        if lib.bls_aggregate_sigs(b"".join(sigs), len(sigs), out) == 0:
+            return out.raw
+        return None
     acc = E2.infinity()
     for sg in sigs:
         pt = g2_uncompress(sg)
@@ -845,6 +912,22 @@ def aggregate_verify(
         return False
     if len({bytes(m) for m in msgs}) != len(msgs):
         return False  # basic scheme forbids repeated messages
+    lib = _nat()
+    if lib is not None and len(agg_sig) == SIGNATURE_SIZE and all(
+        len(p) == PUB_KEY_SIZE for p in pubs
+    ):
+        import ctypes
+
+        off = [0]
+        for m in msgs:
+            off.append(off[-1] + len(m))
+        offs = (ctypes.c_int64 * len(off))(*off)
+        return (
+            lib.bls_aggregate_verify(
+                b"".join(pubs), b"".join(msgs), offs, len(pubs), agg_sig
+            )
+            == 1
+        )
     s = g2_uncompress(agg_sig)
     if s is None or not _g2_subgroup(s):
         return False

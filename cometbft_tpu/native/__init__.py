@@ -16,13 +16,28 @@ from typing import Optional
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "csrc", "cometbft_native.cpp")
 _SO = os.path.join(_HERE, "_cometbft_native.so")
+_BLS_SRC = os.path.join(_HERE, "csrc", "bls12381.cpp")
+_BLS_SO = os.path.join(_HERE, "_cometbft_bls.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_bls_lib_handle: Optional[ctypes.CDLL] = None
+_bls_tried = False
 
 
-def _build() -> bool:
+def _fresh(so: str, src: str) -> bool:
+    """True when the built library can be used as-is.  A missing source
+    next to an existing .so (e.g. a packaged build) counts as fresh."""
+    if not os.path.exists(so):
+        return False
+    try:
+        return os.path.getmtime(so) >= os.path.getmtime(src)
+    except OSError:
+        return True
+
+
+def _build(src: str, so: str) -> bool:
     try:
         subprocess.run(
             [
@@ -32,14 +47,14 @@ def _build() -> bool:
                 "-fPIC",
                 "-std=c++17",
                 "-o",
-                _SO + ".tmp",
-                _SRC,
+                so + ".tmp",
+                src,
             ],
             check=True,
             capture_output=True,
             timeout=120,
         )
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(so + ".tmp", so)
         return True
     except (subprocess.SubprocessError, OSError, FileNotFoundError):
         return False
@@ -54,8 +69,7 @@ def lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("COMETBFT_TPU_NO_NATIVE"):
             return None
-        fresh = os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
-        if not fresh and not _build():
+        if not _fresh(_SO, _SRC) and not _build(_SRC, _SO):
             return None
         try:
             cdll = ctypes.CDLL(_SO)
@@ -93,3 +107,62 @@ def lib() -> Optional[ctypes.CDLL]:
         cdll.sha512.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
         _lib = cdll
         return _lib
+
+
+def bls() -> Optional[ctypes.CDLL]:
+    """The BLS12-381 pairing library (the blst analog, SURVEY §2.1.1),
+    building it on first use; None when the toolchain, the build, or the
+    library's own pairing self-check (``bls_init``) is unavailable."""
+    global _bls_lib_handle, _bls_tried
+    with _lock:
+        if _bls_lib_handle is not None or _bls_tried:
+            return _bls_lib_handle
+        _bls_tried = True
+        if os.environ.get("COMETBFT_TPU_NO_NATIVE"):
+            return None
+        if not _fresh(_BLS_SO, _BLS_SRC) and not _build(_BLS_SRC, _BLS_SO):
+            return None
+        try:
+            cdll = ctypes.CDLL(_BLS_SO)
+        except OSError:
+            return None
+        c = ctypes
+        cdll.bls_init.restype = c.c_int
+        cdll.bls_pubkey_from_sk.restype = c.c_int
+        cdll.bls_pubkey_from_sk.argtypes = [c.c_char_p, c.c_char_p]
+        cdll.bls_pubkey_validate.restype = c.c_int
+        cdll.bls_pubkey_validate.argtypes = [c.c_char_p, c.c_int64]
+        cdll.bls_sign.restype = c.c_int
+        cdll.bls_sign.argtypes = [c.c_char_p, c.c_char_p, c.c_int64, c.c_char_p]
+        cdll.bls_verify.restype = c.c_int
+        cdll.bls_verify.argtypes = [
+            c.c_char_p, c.c_int64, c.c_char_p, c.c_int64, c.c_char_p,
+        ]
+        cdll.bls_aggregate_sigs.restype = c.c_int
+        cdll.bls_aggregate_sigs.argtypes = [c.c_char_p, c.c_int64, c.c_char_p]
+        cdll.bls_aggregate_verify.restype = c.c_int
+        cdll.bls_aggregate_verify.argtypes = [
+            c.c_char_p, c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_char_p,
+        ]
+        cdll.bls_hash_to_g2.restype = c.c_int
+        cdll.bls_hash_to_g2.argtypes = [c.c_char_p, c.c_int64, c.c_char_p]
+        cdll.bls_sig_validate.restype = c.c_int
+        cdll.bls_sig_validate.argtypes = [c.c_char_p]
+        cdll.bls_g1_scalar_mul.restype = c.c_int
+        cdll.bls_g1_scalar_mul.argtypes = [
+            c.c_char_p, c.c_char_p, c.c_int64, c.c_char_p,
+        ]
+        cdll.bls_g2_scalar_mul_compressed.restype = c.c_int
+        cdll.bls_g2_scalar_mul_compressed.argtypes = [
+            c.c_char_p, c.c_char_p, c.c_int64, c.c_char_p,
+        ]
+        cdll.bls_pairing_product_is_one_serialized.restype = c.c_int
+        cdll.bls_pairing_product_is_one_serialized.argtypes = [
+            c.c_char_p, c.c_char_p, c.c_int64,
+        ]
+        # the library refuses to serve if its constants or pairing are
+        # inconsistent (bilinearity/non-degeneracy/inversion self-checks)
+        if cdll.bls_init() != 0:
+            return None
+        _bls_lib_handle = cdll
+        return _bls_lib_handle

@@ -1,0 +1,172 @@
+"""Differential tests: native C++ BLS12-381 backend vs the Python oracle.
+
+The native library (cometbft_tpu/native/csrc/bls12381.cpp — the blst
+analog, SURVEY §2.1.1; reference crypto/bls12381/key_bls12381.go:31-188)
+must agree bit-for-bit with crypto/bls12381.py on every serialized
+output, and agree on accept/reject for every verification path.  Skipped
+wholesale when the toolchain can't build the library (the Python oracle
+then serves alone, slower but identical).
+"""
+
+import ctypes
+
+import pytest
+
+from cometbft_tpu import native
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto import bls12381 as bls
+
+lib = native.bls()
+
+pytestmark = pytest.mark.skipif(
+    lib is None, reason="native BLS library unavailable (no toolchain?)"
+)
+
+
+def _sk(tag: bytes) -> int:
+    return bls.gen_privkey_from_secret(tag)
+
+
+class TestDifferential:
+    def test_init_self_check(self):
+        assert lib.bls_init() == 0
+
+    def test_pubkey_matches_oracle(self):
+        for i in range(3):
+            sk = _sk(b"pk-%d" % i)
+            out = ctypes.create_string_buffer(96)
+            assert lib.bls_pubkey_from_sk(sk.to_bytes(32, "big"), out) == 0
+            assert out.raw == bls.g1_serialize(
+                bls.E1.mul_scalar(bls.G1_GEN, sk)
+            )
+
+    def test_hash_to_g2_matches_oracle(self):
+        for msg in (b"", b"abc", b"a longer message for hash_to_curve"):
+            out = ctypes.create_string_buffer(96)
+            assert lib.bls_hash_to_g2(msg, len(msg), out) == 0
+            u0, u1 = bls._hash_to_field_fp2(msg, 2, bls.DST)
+            q0 = bls._iso_map(*bls._sswu_map(u0))
+            q1 = bls._iso_map(*bls._sswu_map(u1))
+            s = bls.E2.add_pts(
+                (q0[0], q0[1], bls.F2_ONE), (q1[0], q1[1], bls.F2_ONE)
+            )
+            py = bls.g2_compress(bls.E2.mul_scalar(s, bls.H_EFF_G2))
+            assert out.raw == py
+
+    def test_sign_matches_oracle(self):
+        sk = _sk(b"sign-diff")
+        msg = b"the vote bytes"
+        out = ctypes.create_string_buffer(96)
+        assert lib.bls_sign(sk.to_bytes(32, "big"), msg, len(msg), out) == 0
+        py = bls.g2_compress(bls.E2.mul_scalar(_pure_hash(msg), sk))
+        assert out.raw == py
+
+    def test_verify_accept_and_reject(self):
+        sk = _sk(b"verify-diff")
+        pub = bls.pubkey(sk)
+        msg = b"msg-ok"
+        sig = bls.sign(sk, msg)
+        assert lib.bls_verify(pub, 96, msg, len(msg), sig) == 1
+        assert lib.bls_verify(pub, 96, b"msg-bad", 7, sig) == 0
+        bad = bytes([sig[0]]) + sig[1:-1] + bytes([sig[-1] ^ 1])
+        assert lib.bls_verify(pub, 96, msg, len(msg), bad) == 0
+
+    def test_g2_scalar_mul_matches_oracle(self):
+        sk = _sk(b"g2mul")
+        sig = bls.sign(sk, b"base")
+        r = 0xDEADBEEF_CAFEBABE_12345678_9ABCDEF1
+        out = ctypes.create_string_buffer(96)
+        rb = r.to_bytes(16, "big")
+        assert lib.bls_g2_scalar_mul_compressed(sig, rb, 16, out) == 0
+        py = bls.g2_compress(bls.E2.mul_scalar(bls.g2_uncompress(sig), r))
+        assert out.raw == py
+
+    def test_g1_scalar_mul_matches_oracle(self):
+        pub = bls.pubkey(_sk(b"g1mul"))
+        r = 0x1234567890ABCDEF
+        out = ctypes.create_string_buffer(96)
+        rb = r.to_bytes(8, "big")
+        assert lib.bls_g1_scalar_mul(pub, rb, 8, out) == 0
+        py = bls.g1_serialize(
+            bls.E1.mul_scalar(bls.g1_deserialize(pub), r)
+        )
+        assert out.raw == py
+
+    def test_negate_serialized(self):
+        pub = bls.pubkey(_sk(b"neg"))
+        neg = bls.g1_negate_serialized(pub)
+        py = bls.g1_serialize(bls.E1.neg_pt(bls.g1_deserialize(pub)))
+        assert neg == py
+        inf = bls.g1_serialize(bls.E1.infinity())
+        assert bls.g1_negate_serialized(inf) == inf
+
+
+def _pure_hash(msg: bytes):
+    """hash_to_g2 forced through the pure-Python path (bypasses the
+    native dispatch inside bls.hash_to_g2)."""
+    u0, u1 = bls._hash_to_field_fp2(msg, 2, bls.DST)
+    q0 = bls._iso_map(*bls._sswu_map(u0))
+    q1 = bls._iso_map(*bls._sswu_map(u1))
+    s = bls.E2.add_pts((q0[0], q0[1], bls.F2_ONE), (q1[0], q1[1], bls.F2_ONE))
+    return bls.E2.mul_scalar(s, bls.H_EFF_G2)
+
+
+class TestAggregateNative:
+    def _fixture(self, n):
+        sks = [_sk(b"agg-%d" % i) for i in range(n)]
+        pubs = [bls.pubkey(sk) for sk in sks]
+        msgs = [b"agg-msg-%d" % i for i in range(n)]
+        sigs = [bls.sign(sk, m) for sk, m in zip(sks, msgs)]
+        return pubs, msgs, sigs
+
+    def test_aggregate_verify(self):
+        pubs, msgs, sigs = self._fixture(5)
+        agg = bls.aggregate_signatures(sigs)
+        assert agg is not None
+        assert bls.aggregate_verify(pubs, msgs, agg)
+        bad_msgs = list(msgs)
+        bad_msgs[2] = b"tampered"
+        assert not bls.aggregate_verify(pubs, bad_msgs, agg)
+
+    def test_batch_verifier_native_path(self):
+        pubs, msgs, sigs = self._fixture(6)
+        v = cbatch.BlsBatchVerifier(backend="cpu")
+        for p, m, s in zip(pubs, msgs, sigs):
+            v.add(p, m, s)
+        ok, bits = v.verify()
+        assert ok and all(bits)
+
+    def test_batch_verifier_attribution(self):
+        pubs, msgs, sigs = self._fixture(6)
+        sigs[3] = sigs[2]  # valid sig, wrong message -> culprit
+        v = cbatch.BlsBatchVerifier(backend="cpu")
+        for p, m, s in zip(pubs, msgs, sigs):
+            v.add(p, m, s)
+        ok, bits = v.verify()
+        assert not ok
+        assert bits == [True, True, True, False, True, True]
+
+    def test_batch_verifier_structural_reject(self):
+        pubs, msgs, sigs = self._fixture(3)
+        sigs[1] = bytes(96)  # not a valid compressed point
+        v = cbatch.BlsBatchVerifier(backend="cpu")
+        for p, m, s in zip(pubs, msgs, sigs):
+            v.add(p, m, s)
+        ok, bits = v.verify()
+        assert not ok
+        assert bits == [True, False, True]
+
+
+class TestPairingProductSerialized:
+    def test_bilinearity_via_product(self):
+        # e(2P, Q) * e(-P, 2Q) == 1
+        p2 = bls.g1_serialize(bls.E1.mul_scalar(bls.G1_GEN, 2))
+        pn = bls.g1_negate_serialized(bls.g1_serialize(bls.G1_GEN))
+        q = bls.g2_compress(bls.G2_GEN)
+        q2 = bls.g2_compress(bls.E2.mul_scalar(bls.G2_GEN, 2))
+        rc = lib.bls_pairing_product_is_one_serialized(p2 + pn, q + q2, 2)
+        assert rc == 1
+        # non-degeneracy: e(P, Q) != 1
+        p = bls.g1_serialize(bls.G1_GEN)
+        rc = lib.bls_pairing_product_is_one_serialized(p, q, 1)
+        assert rc == 0
