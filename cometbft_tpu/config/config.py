@@ -78,6 +78,9 @@ class RPCConfig:
     max_request_batch_size: int = 10
     max_body_bytes: int = 1_000_000
     pprof_laddr: str = ""
+    # serve the dial_seeds/dial_peers/unsafe_flush_mempool routes
+    # (reference config.go RPCConfig.Unsafe + routes.go AddUnsafeRoutes)
+    unsafe: bool = False
 
     def validate_basic(self) -> Optional[str]:
         if self.max_open_connections < 0:

@@ -163,6 +163,13 @@ class CListMempool:
     def contains(self, tx_key: bytes) -> bool:
         return tx_key in self._tx_map
 
+    def get_tx_by_hash(self, tx_hash: bytes):
+        """The queued tx bytes with this hash, or None (reference:
+        mempool/clist_mempool.go GetTxByHash via the unconfirmed_tx RPC,
+        rpc/core/mempool.go:189-197)."""
+        el = self._tx_map.get(tx_hash)
+        return el.value.tx if el is not None else None
+
     def enable_txs_available(self) -> None:
         self._txs_available = threading.Event()
 
@@ -365,6 +372,9 @@ class NopMempool:
 
     def contains(self, tx_key: bytes) -> bool:
         return False
+
+    def get_tx_by_hash(self, tx_hash: bytes):
+        return None
 
     def enable_txs_available(self) -> None:
         pass

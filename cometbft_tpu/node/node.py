@@ -299,12 +299,18 @@ class Node(BaseService):
 
         config = self.config
         # channels advertised in the node info (filled below by reactors)
+        from cometbft_tpu.version import CMT_SEMVER
+
         self._node_info = NodeInfo(
             node_id=self.node_key.node_id,
             network=self.genesis_doc.chain_id,
             listen_addr=config.p2p.external_address or config.p2p.laddr,
             moniker=config.base.moniker,
             rpc_address=config.rpc.laddr,
+            # the wire-advertised version must track the running build —
+            # the e2e upgrade perturbation restarts nodes under a new
+            # COMETBFT_TPU_SEMVER and peers must see it in the handshake
+            version=CMT_SEMVER,
         )
         latency = None
         if config.p2p.zone:

@@ -56,7 +56,7 @@ class NodeInfo:
     node_id: str
     network: str  # chain id
     listen_addr: str = ""
-    version: str = "0.1.0"
+    version: str = ""  # set from version.CMT_SEMVER at construction
     channels: bytes = b""
     moniker: str = ""
     p2p_protocol: int = P2P_PROTOCOL

@@ -278,6 +278,15 @@ class Environment:
             raise RPCError(-32603, f"header {h} not found")
         return {"header": _header_json(meta.header)}
 
+    def header_by_hash(self, hash_: str) -> dict:
+        """Reference: rpc/core/blocks.go HeaderByHash:106-117 — an unknown
+        hash returns a null header, not an error."""
+        raw = _bytes_arg(hash_)
+        meta = self.node.block_store.load_block_meta_by_hash(raw)
+        if meta is None:
+            return {"header": None}
+        return {"header": _header_json(meta.header)}
+
     def commit(self, height: Optional[int] = None) -> dict:
         h = self._height_or_latest(height)
         meta = self.node.block_store.load_block_meta(h)
@@ -556,6 +565,71 @@ class Environment:
             "total_bytes": str(self.node.mempool.size_bytes()),
         }
 
+    def unconfirmed_tx(self, hash_: str) -> dict:
+        """A single queued tx by hash (reference: rpc/core/mempool.go
+        UnconfirmedTx:189-197 — error only on an empty hash; an unknown
+        hash returns a null tx)."""
+        raw = _bytes_arg(hash_)
+        if not raw:
+            raise RPCError(-32602, "transaction hash cannot be empty")
+        tx = self.node.mempool.get_tx_by_hash(raw)
+        return {"tx": _b64(tx) if tx is not None else None}
+
+    # -- unsafe routes (served only when config rpc.unsafe is set; the
+    #    reference adds these via AddUnsafeRoutes, routes.go:59-64) -------
+
+    @staticmethod
+    def _addr_list(value, what: str) -> list:
+        """Normalize an address-list param (JSON array, or a
+        comma-separated string from the URI form) and validate every
+        address up front — the reference returns ErrInvalidPeerAddr
+        rather than dialing a partial list (rpc/core/net.go:50-86)."""
+        from cometbft_tpu.p2p.node_info import NetAddress
+
+        if isinstance(value, str):
+            value = [a for a in value.split(",") if a]
+        if not isinstance(value, list) or not value:
+            raise RPCError(-32602, f"no {what} provided")
+        for a in value:
+            if not isinstance(a, str):
+                raise RPCError(-32602, f"{what} must be strings: {a!r}")
+            try:
+                NetAddress.parse(a)
+            except Exception as e:  # noqa: BLE001
+                raise RPCError(-32602, f"invalid {what} address {a!r}: {e}")
+        return value
+
+    def dial_seeds(self, seeds=None) -> dict:
+        """Reference: rpc/core/net.go UnsafeDialSeeds:50-59."""
+        addrs = self._addr_list(seeds, "seeds")
+        sw = getattr(self.node, "switch", None)
+        if sw is None:
+            raise RPCError(-32603, "p2p switch unavailable")
+        sw.dial_peers_async(addrs, persistent=False)
+        return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+    def dial_peers(
+        self,
+        peers=None,
+        persistent: bool = False,
+        unconditional: bool = False,
+        private: bool = False,
+    ) -> dict:
+        """Reference: rpc/core/net.go UnsafeDialPeers:61-86 (the
+        unconditional/private markers are accepted for wire parity; this
+        switch tracks persistence only)."""
+        addrs = self._addr_list(peers, "peers")
+        sw = getattr(self.node, "switch", None)
+        if sw is None:
+            raise RPCError(-32603, "p2p switch unavailable")
+        sw.dial_peers_async(addrs, persistent=bool(persistent))
+        return {"log": "Dialing peers in progress. See /net_info for details"}
+
+    def unsafe_flush_mempool(self) -> dict:
+        """Reference: rpc/core/dev.go UnsafeFlushMempool:8-12."""
+        self.node.mempool.flush()
+        return {}
+
     def check_tx(self, tx: str) -> dict:
         raw = _bytes_arg(tx)
         res = self.node.proxy_app.mempool.check_tx(at.CheckTxRequest(tx=raw))
@@ -643,6 +717,7 @@ ROUTES = {
     "block_by_hash": "block_by_hash",
     "block_results": "block_results",
     "header": "header",
+    "header_by_hash": "header_by_hash",
     "commit": "commit",
     "validators": "validators",
     "consensus_params": "consensus_params",
@@ -655,11 +730,20 @@ ROUTES = {
     "broadcast_tx_commit": "broadcast_tx_commit",
     "unconfirmed_txs": "unconfirmed_txs",
     "num_unconfirmed_txs": "num_unconfirmed_txs",
+    "unconfirmed_tx": "unconfirmed_tx",
     "check_tx": "check_tx",
     "tx": "tx",
     "tx_search": "tx_search",
     "block_search": "block_search",
     "broadcast_evidence": "broadcast_evidence",
+}
+
+# Served only when config rpc.unsafe is true (reference AddUnsafeRoutes,
+# rpc/core/routes.go:59-64); the server refuses them otherwise.
+UNSAFE_ROUTES = {
+    "dial_seeds": "dial_seeds",
+    "dial_peers": "dial_peers",
+    "unsafe_flush_mempool": "unsafe_flush_mempool",
 }
 
 # JSON-RPC params that should be ints
@@ -672,7 +756,7 @@ _INT_PARAMS = {
     "limit",
     "chunk",
 }
-_BOOL_PARAMS = {"prove"}
+_BOOL_PARAMS = {"prove", "persistent", "unconditional", "private"}
 
 
 def coerce_params(params: dict) -> dict:

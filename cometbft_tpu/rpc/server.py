@@ -208,6 +208,18 @@ class RPCServer:
             )
         fn_name = rpccore.ROUTES.get(method)
         if fn_name is None:
+            fn_name = rpccore.UNSAFE_ROUTES.get(method)
+            if fn_name is not None and not getattr(
+                self.config, "unsafe", False
+            ):
+                return _rpc_response(
+                    id_,
+                    error={
+                        "code": -32601,
+                        "message": f"method {method!r} requires rpc.unsafe",
+                    },
+                )
+        if fn_name is None:
             return _rpc_response(
                 id_, error={"code": -32601, "message": f"method {method!r} not found"}
             )

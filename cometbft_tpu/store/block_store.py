@@ -233,14 +233,20 @@ class BlockStore:
         raw = self._db.get(_k_ext_commit(height))
         return codec.decode_extended_commit(raw) if raw else None
 
-    def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
+    def load_block_meta_by_hash(self, block_hash: bytes) -> Optional[BlockMeta]:
+        """Reference: store.go LoadBlockMetaByHash — meta only, so callers
+        like header_by_hash never decode a full block's txs."""
         with self._lock:
             lo, hi = self._base, self._height
         for h in range(hi, lo - 1, -1):
             meta = self.load_block_meta(h)
             if meta and meta.block_id.hash == block_hash:
-                return self.load_block(h)
+                return meta
         return None
+
+    def load_block_by_hash(self, block_hash: bytes) -> Optional[Block]:
+        meta = self.load_block_meta_by_hash(block_hash)
+        return self.load_block(meta.header.height) if meta else None
 
     # -- pruning ----------------------------------------------------------
 

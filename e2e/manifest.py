@@ -10,7 +10,7 @@ import tomllib
 from dataclasses import dataclass, field
 
 
-VALID_PERTURBATIONS = {"kill", "pause", "restart", "disconnect"}
+VALID_PERTURBATIONS = {"kill", "pause", "restart", "disconnect", "upgrade"}
 VALID_MODES = {"validator", "full"}
 VALID_ABCI = {"builtin", "socket", "grpc"}
 
@@ -23,7 +23,8 @@ class NodeManifest:
     abci_protocol: str = "builtin"  # builtin | socket | grpc
     state_sync: bool = False
     start_at: int = 0  # join at this height (0 = from genesis)
-    perturb: list = field(default_factory=list)  # kill|pause|restart|disconnect
+    # kill|pause|restart|disconnect|upgrade
+    perturb: list = field(default_factory=list)
     zone: str = ""  # latency-emulation zone (see Manifest.zones)
 
 
@@ -34,6 +35,9 @@ class Manifest:
     load_tx_rate: int = 20  # txs/s during the load phase
     load_tx_bytes: int = 256
     wait_height: int = 6  # target height for the run phase
+    # version the "upgrade" perturbation restarts nodes as (reference
+    # Testnet.UpgradeVersion, test/e2e/pkg/manifest.go)
+    upgrade_version: str = ""
     nodes: list = field(default_factory=list)
     # zone-pair RTT matrix (ms) for WAN latency emulation — the reference's
     # tc-based zone tables (test/e2e/pkg/latency/); applied per-link by the
@@ -59,6 +63,12 @@ class Manifest:
                     raise ValueError(f"{n.name}: bad perturbation {p!r}")
         if not any(n.mode == "validator" for n in self.nodes):
             raise ValueError("manifest has no validators")
+        if any("upgrade" in n.perturb for n in self.nodes) and (
+            not self.upgrade_version
+        ):
+            raise ValueError(
+                "upgrade perturbation requires manifest upgrade_version"
+            )
         known_zones = set(self.zones)
         for row in self.zones.values():
             known_zones.update(row)
@@ -76,6 +86,7 @@ def load_manifest(path: str) -> Manifest:
         load_tx_rate=doc.get("load_tx_rate", 20),
         load_tx_bytes=doc.get("load_tx_bytes", 256),
         wait_height=doc.get("wait_height", 6),
+        upgrade_version=doc.get("upgrade_version", ""),
         zones={
             str(a): {str(b): float(v) for b, v in row.items()}
             for a, row in doc.get("zones", {}).items()

@@ -46,6 +46,8 @@ class RunningNode:
     log_path: str = ""
     app_proc: subprocess.Popen | None = None  # socket/grpc ABCI app
     app_laddr: str = ""
+    upgraded: bool = False  # the "upgrade" perturbation is one-shot
+    env_extra: dict = field(default_factory=dict)
 
     @property
     def rpc(self) -> NodeRPC:
@@ -234,12 +236,14 @@ class Testnet:
         # the 'ab' handle is only for Popen inheritance; the child keeps
         # its own duplicate, so close ours (no fd leak across restarts)
         with open(node.log_path, "ab") as logf:
+            env = self._child_env()
+            env.update(node.env_extra)
             node.proc = subprocess.Popen(
                 [sys.executable, "-m", "cometbft_tpu.cmd",
                  "--home", node.home, "start"],
                 stdout=logf,
                 stderr=subprocess.STDOUT,
-                env=self._child_env(),
+                env=env,
                 cwd=REPO,
             )
 
@@ -393,6 +397,30 @@ class Testnet:
                     node.proc.send_signal(signal.SIGSTOP)
                     time.sleep(6.0)
                     node.proc.send_signal(signal.SIGCONT)
+                elif p == "upgrade":
+                    # binary-upgrade analog (reference perturb.go:88-131
+                    # swaps docker images): restart the OS process as the
+                    # manifest's upgrade_version; state must carry over
+                    if node.upgraded:
+                        raise RuntimeError(
+                            f"{node.manifest.name}: can't upgrade twice"
+                        )
+                    new_v = self.manifest.upgrade_version
+                    node.proc.send_signal(signal.SIGTERM)
+                    node.proc.wait(timeout=15)
+                    node.upgraded = True
+                    node.env_extra["COMETBFT_TPU_SEMVER"] = new_v
+                    self.start_node(node)
+                    if not node.rpc.wait_for_height(1, timeout=60):
+                        raise TimeoutError(
+                            f"{node.manifest.name} dead after upgrade"
+                        )
+                    got = node.rpc.status()["node_info"]["version"]
+                    if got != new_v:
+                        raise RuntimeError(
+                            f"{node.manifest.name} upgraded to {got!r}, "
+                            f"wanted {new_v!r}"
+                        )
 
     # -- invariants (reference: test/e2e/tests/*_test.go) -----------------
 

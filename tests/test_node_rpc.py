@@ -138,6 +138,37 @@ def test_broadcast_tx_sync_and_unconfirmed(node):
         raise AssertionError("tx stuck in mempool")
 
 
+def test_header_by_hash_and_unconfirmed_tx(node):
+    """Round-4 parity routes (reference rpc/core/routes.go:31,40)."""
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    meta = _rpc(port, "blockchain", {"min_height": 1, "max_height": 1})
+    bhash = meta["block_metas"][0]["block_id"]["hash"]
+    res = _rpc(port, "header_by_hash", {"hash": bhash})
+    assert res["header"]["height"] == "1"
+    # unknown mempool hash -> null tx, no error (reference semantics)
+    res = _rpc(port, "unconfirmed_tx", {"hash": "AA" * 32})
+    assert res["tx"] is None
+    with pytest.raises(RuntimeError, match="empty"):
+        _rpc(port, "unconfirmed_tx", {"hash": ""})
+
+
+def test_unsafe_routes_gated(node):
+    """dial_seeds/dial_peers/unsafe_flush_mempool serve only with
+    config rpc.unsafe (reference AddUnsafeRoutes, routes.go:59-64)."""
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    with pytest.raises(RuntimeError, match="unsafe"):
+        _rpc(port, "unsafe_flush_mempool")
+    node.rpc_server.config.unsafe = True
+    try:
+        assert _rpc(port, "unsafe_flush_mempool") == {}
+        with pytest.raises(RuntimeError, match="no peers"):
+            _rpc(port, "dial_peers", {"peers": []})
+    finally:
+        node.rpc_server.config.unsafe = False
+
+
 def test_uri_get_routes(node):
     port = node.rpc_server.bound_port
     _wait_height(node, 1)

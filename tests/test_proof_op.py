@@ -1,0 +1,97 @@
+"""Merkle proof-operator chain tests (reference: crypto/merkle/
+proof_op.go + proof_value.go + proof_key_path.go test files)."""
+
+import pytest
+
+from cometbft_tpu.crypto import merkle, proof_op as po
+
+
+class TestKeyPath:
+    def test_roundtrip_url_and_hex(self):
+        kp = (
+            po.KeyPath()
+            .append_key(b"App", po.KEY_ENCODING_URL)
+            .append_key(b"IBC", po.KEY_ENCODING_URL)
+            .append_key(b"\x01\x02\x03", po.KEY_ENCODING_HEX)
+        )
+        assert str(kp) == "/App/IBC/x:010203"
+        assert po.key_path_to_keys(str(kp)) == [b"App", b"IBC", b"\x01\x02\x03"]
+
+    def test_url_escaping(self):
+        kp = po.KeyPath().append_key(b"a/b c", po.KEY_ENCODING_URL)
+        assert "/" not in str(kp)[1:]
+        assert po.key_path_to_keys(str(kp)) == [b"a/b c"]
+
+    def test_rejects_bad_paths(self):
+        with pytest.raises(po.ProofError):
+            po.key_path_to_keys("no-leading-slash")
+        with pytest.raises(po.ProofError):
+            po.key_path_to_keys("/x:zz")
+
+
+class TestValueOpChain:
+    def _store(self):
+        return {b"k%d" % i: b"value-%d" % i for i in range(7)}
+
+    def test_single_tree_verify(self):
+        root, ops = po.proofs_from_map(self._store())
+        prt = po.default_proof_runtime()
+        chain = po.ProofOps(ops=[ops[b"k3"].proof_op()])
+        prt.verify_value(chain, root, "/x:" + b"k3".hex(), b"value-3")
+
+    def test_wrong_value_rejected(self):
+        root, ops = po.proofs_from_map(self._store())
+        prt = po.default_proof_runtime()
+        chain = po.ProofOps(ops=[ops[b"k3"].proof_op()])
+        with pytest.raises(po.ProofError):
+            prt.verify_value(chain, root, "/x:" + b"k3".hex(), b"value-4")
+
+    def test_wrong_key_rejected(self):
+        root, ops = po.proofs_from_map(self._store())
+        prt = po.default_proof_runtime()
+        chain = po.ProofOps(ops=[ops[b"k3"].proof_op()])
+        with pytest.raises(po.ProofError, match="key mismatch"):
+            prt.verify_value(chain, root, "/x:" + b"k4".hex(), b"value-3")
+
+    def test_two_tree_chain(self):
+        """An app store tree whose root is a value in an outer multistore
+        tree — the composition proof_op.go exists for."""
+        store_root, store_ops = po.proofs_from_map(self._store())
+        outer = {b"app": store_root, b"other": b"\xaa" * 32}
+        outer_root, outer_ops = po.proofs_from_map(outer)
+        chain = po.ProofOps(
+            ops=[store_ops[b"k5"].proof_op(), outer_ops[b"app"].proof_op()]
+        )
+        prt = po.default_proof_runtime()
+        keypath = "/x:" + b"app".hex() + "/x:" + b"k5".hex()
+        prt.verify_value(chain, outer_root, keypath, b"value-5")
+        # path segments out of order must fail
+        bad = "/x:" + b"k5".hex() + "/x:" + b"app".hex()
+        with pytest.raises(po.ProofError):
+            prt.verify_value(chain, outer_root, bad, b"value-5")
+
+    def test_unconsumed_keypath_rejected(self):
+        root, ops = po.proofs_from_map(self._store())
+        prt = po.default_proof_runtime()
+        chain = po.ProofOps(ops=[ops[b"k1"].proof_op()])
+        with pytest.raises(po.ProofError, match="not consumed"):
+            prt.verify_value(
+                chain, root, "/x:" + b"extra".hex() + "/x:" + b"k1".hex(),
+                b"value-1",
+            )
+
+    def test_wire_roundtrip(self):
+        root, ops = po.proofs_from_map(self._store())
+        chain = po.ProofOps(ops=[ops[b"k2"].proof_op()])
+        raw = chain.encode()
+        decoded = po.ProofOps.decode(raw)
+        assert decoded.ops[0].type == po.PROOF_OP_VALUE
+        assert decoded.ops[0].key == b"k2"
+        prt = po.default_proof_runtime()
+        prt.verify_value(decoded, root, "/x:" + b"k2".hex(), b"value-2")
+
+    def test_unknown_op_type_rejected(self):
+        prt = po.default_proof_runtime()
+        bad = po.ProofOps(ops=[po.ProofOp(type="iavl:v", key=b"k", data=b"")])
+        with pytest.raises(po.ProofError, match="unrecognized"):
+            prt.decode_proof(bad)
