@@ -253,9 +253,18 @@ def worker(platform_mode: str) -> None:
         )
 
     # end-to-end at the largest batch (host SHA-512/packing + transfer +
-    # dispatch) — the number consensus actually sees.
+    # dispatch) — the number consensus actually sees.  verify_batch goes
+    # through the jitted (not AOT) path, so a cold cache can cost another
+    # Mosaic compile here: emit a compile heartbeat so the orchestrator
+    # grants the compile-sized stall budget (ADVICE r4).
     eb = batches[-1]
     pubs, msgs, sigs = prep[eb]
+    _emit(
+        _result_line(
+            f"compile-e2e-{eb}", 0.0,
+            dict(impl=impl, platform=platform, partial=True, batch=eb),
+        )
+    )
     t0 = time.perf_counter()
     bits = _retry_unavailable(lambda: ov.verify_batch(pubs, msgs, sigs))
     e2e_s = time.perf_counter() - t0

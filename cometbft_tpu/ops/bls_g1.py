@@ -185,6 +185,10 @@ def pack_points(points: Sequence[Optional[tuple]], batch: int | None = None) -> 
     """Affine (x, y) int pairs (None = infinity) -> projective G1 batch,
     padded with identity to ``batch`` (rounded up to a power of two)."""
     n = len(points)
+    if batch is not None and batch < n:
+        raise ValueError(
+            f"batch {batch} would silently drop {n - batch} trailing points"
+        )
     b = batch if batch is not None else n
     b = 1 << max(b - 1, 0).bit_length() if b > 1 else 1  # next pow2
     xs, ys, zs = [], [], []

@@ -63,6 +63,7 @@ class DelayedSocket:
         self._sock = sock
         self._delay_s = 0.0
         self._queue = collections.deque()  # (due_monotonic, bytes)
+        self._queued_ever = False  # once armed, never take the fast path
         self._cv = threading.Condition()
         self._closed = False
         self._err: Optional[BaseException] = None
@@ -87,10 +88,14 @@ class DelayedSocket:
                 raise self._err
             if self._closed:
                 raise OSError("socket closed")
-            if self._delay_s <= 0.0 and not self._queue:
-                # fast path: no emulation armed, no reordering risk
+            if self._delay_s <= 0.0 and not self._queued_ever:
+                # fast path: emulation never armed, no reordering risk.
+                # Once ANY byte has been queued the writer thread may still
+                # hold a popped-but-unwritten chunk, so direct sendall could
+                # reorder — from then on everything queues (ADVICE r4).
                 pass
             else:
+                self._queued_ever = True
                 self._queue.append((time.monotonic() + self._delay_s, bytes(data)))
                 self._cv.notify()
                 return

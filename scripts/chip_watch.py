@@ -1,0 +1,139 @@
+"""Session-long TPU chip watcher (VERDICT r4 #1).
+
+The axon tunnel to the chip comes and goes; previous rounds only tried to
+reach it during the bench window and recorded host-fallback numbers when it
+happened to be down.  This watcher runs for the WHOLE build session:
+
+  loop:
+    probe the chip in a killable subprocess (bounded)
+    if it answers:
+        run the full staged bench (bench.py orchestrator) — this
+        validates the compiled kernel on-chip (CHIP_VALIDATE.json with
+        platform=tpu), warms both the JAX persistent compilation cache and
+        the AOT executable cache, and records an honest on-chip number in
+        BENCH_CHIPWATCH.json
+    sleep; repeat (the tunnel may flap — later runs with warm caches are
+    cheaper and refresh the artifact)
+
+Never imports jax itself (the tunnel can wedge platform init); all chip
+work happens in subprocesses bench.py already knows how to kill.
+
+Usage:  python scripts/chip_watch.py [--interval 180] [--once]
+Writes: chipwatch.log (append), BENCH_CHIPWATCH.json (latest tpu result
+        lines), CHIP_VALIDATE.json (via the bench worker).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "chipwatch.log")
+ARTIFACT = os.path.join(REPO, "BENCH_CHIPWATCH.json")
+
+
+def log(msg: str) -> None:
+    line = "%s %s" % (time.strftime("%H:%M:%S"), msg)
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe(timeout_s: float = 120.0) -> dict | None:
+    """Bounded chip probe; returns the probe record or None."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", os.path.join(REPO, "bench.py"), "--probe"],
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("probe") == "ok":
+            return rec
+    return None
+
+
+def run_bench(budget_s: float) -> list[dict]:
+    """Full staged bench via the orchestrator; returns its JSON lines."""
+    env = dict(os.environ)
+    env["BENCH_BUDGET_S"] = str(budget_s)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True,
+            timeout=budget_s + 120.0, cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        log("bench run exceeded its own budget + grace; killed")
+        return []
+    recs = []
+    for line in out.stdout.splitlines():
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            continue
+    return recs
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=180.0,
+                    help="seconds between probes while the chip is down")
+    ap.add_argument("--rebench-interval", type=float, default=3600.0,
+                    help="seconds between bench refreshes once one succeeded")
+    ap.add_argument("--budget", type=float, default=1800.0,
+                    help="bench orchestrator budget per attempt")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe(+bench) attempt, then exit")
+    args = ap.parse_args()
+
+    have_tpu_final = False
+    last_bench_t = 0.0
+    log("chip_watch started (interval=%gs)" % args.interval)
+    while True:
+        rec = probe()
+        if rec is None:
+            log("probe: no answer")
+        else:
+            log("probe: ok platform=%s init_s=%s"
+                % (rec.get("platform"), rec.get("init_s")))
+            is_tpu = rec.get("platform") == "tpu"
+            stale = time.time() - last_bench_t > args.rebench_interval
+            if is_tpu and (not have_tpu_final or stale):
+                log("chip is up — running staged bench (budget=%gs)"
+                    % args.budget)
+                recs = run_bench(args.budget)
+                last_bench_t = time.time()
+                tpu_lines = [r for r in recs if r.get("platform") == "tpu"]
+                final = [r for r in recs
+                         if str(r.get("stage", "")).startswith("final")]
+                for r in recs:
+                    log("bench: %s" % json.dumps(r))
+                if tpu_lines:
+                    with open(ARTIFACT, "w") as f:
+                        for r in recs:
+                            f.write(json.dumps(r) + "\n")
+                    log("wrote %s (%d tpu lines)"
+                        % (ARTIFACT, len(tpu_lines)))
+                if any(r.get("platform") == "tpu" and not r.get("partial")
+                       for r in final):
+                    have_tpu_final = True
+                    log("ON-CHIP FINAL CAPTURED — caches warm; will "
+                        "refresh every %gs" % args.rebench_interval)
+        if args.once:
+            break
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
