@@ -144,6 +144,83 @@ class TpuBatchVerifier(_CollectingVerifier):
         return all(bits), bits
 
 
+_SECP_DEVICE_OK: Optional[bool] = None
+
+
+def _secp_device_ok() -> bool:
+    """Lazy gate for the TPU ECDSA path: a known-answer accept/reject pair
+    must match the host library before consensus trusts the device ladder
+    (same discipline as ``_tpu_self_check``).  COMETBFT_TPU_SECP_DEVICE=1/0
+    forces."""
+    global _SECP_DEVICE_OK
+    env = os.environ.get("COMETBFT_TPU_SECP_DEVICE")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    with _LOCK:
+        if _SECP_DEVICE_OK is None:
+            try:
+                from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+                from cometbft_tpu.ops import secp_verify as sv
+
+                priv = Secp256k1PrivKey.from_secret(
+                    b"cometbft-tpu secp self-check"
+                )
+                pub = priv.pub_key().bytes()
+                msg = b"secp backend self-check"
+                sig = priv.sign(msg)
+                bad = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+                bits = sv.verify_batch([pub, pub], [msg, msg], [sig, bad])
+                _SECP_DEVICE_OK = bool(bits[0]) and not bool(bits[1])
+                if not _SECP_DEVICE_OK:
+                    logging.getLogger("cometbft_tpu.crypto").error(
+                        "TPU secp256k1 backend FAILED its known-answer "
+                        "self-check - using sequential host verification"
+                    )
+            except Exception:
+                _SECP_DEVICE_OK = False
+        return _SECP_DEVICE_OK
+
+
+class Secp256k1BatchVerifier(_CollectingVerifier):
+    """Per-lane batched ECDSA on the device (ops/secp_verify) — a TPU-era
+    extension past the reference, which verifies secp256k1 sequentially
+    (crypto/secp256k1/secp256k1.go; BASELINE config #4 tracks this).
+    Falls back to the host `cryptography` library when the device fails
+    its self-check or ``backend='cpu'`` pins it off."""
+
+    def __init__(self, backend: Optional[str] = None):
+        super().__init__()
+        self._backend = backend
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        if not self.pubs:
+            return False, []
+        if self._backend != "cpu" and _secp_device_ok():
+            try:
+                from cometbft_tpu.ops import secp_verify as sv
+
+                bits = [
+                    bool(b)
+                    for b in sv.verify_batch(self.pubs, self.msgs, self.sigs)
+                ]
+                return all(bits), bits
+            except Exception:
+                logging.getLogger("cometbft_tpu.crypto").exception(
+                    "device secp verify failed; host fallback"
+                )
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PubKey
+
+        bits = []
+        for p, m, s in zip(self.pubs, self.msgs, self.sigs):
+            try:
+                bits.append(Secp256k1PubKey(p).verify_signature(m, s))
+            except ValueError:
+                bits.append(False)
+        return all(bits) and len(bits) > 0, bits
+
+
 _BLS_DEVICE_OK: Optional[bool] = None
 
 
@@ -368,10 +445,12 @@ class BlsBatchVerifier(_CollectingVerifier):
 
 def supports_batch_verifier(pub_key) -> bool:
     """Reference: crypto/batch/batch.go:21 — ed25519 there; bls12_381 joins
-    via the aggregate path (key_bls12381.go:160-188)."""
+    via the aggregate path (key_bls12381.go:160-188); secp256k1 is the
+    TPU-era extension (BASELINE config #4; no batch in the reference)."""
     return getattr(pub_key, "type_", None) in (
         ck.ED25519_KEY_TYPE,
         ck.BLS12381_KEY_TYPE,
+        ck.SECP256K1_KEY_TYPE,
     )
 
 
@@ -379,10 +458,13 @@ def create_batch_verifier(pub_key, backend: Optional[str] = None) -> BatchVerifi
     """Reference: crypto/batch/batch.go:10."""
     if not supports_batch_verifier(pub_key):
         raise ValueError(f"key type does not support batch verification: {pub_key}")
-    if getattr(pub_key, "type_", None) == ck.BLS12381_KEY_TYPE:
+    key_type = getattr(pub_key, "type_", None)
+    if key_type in (ck.BLS12381_KEY_TYPE, ck.SECP256K1_KEY_TYPE):
         env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
         if (backend is None or backend == "auto") and env and env != "auto":
             backend = env
+        if key_type == ck.SECP256K1_KEY_TYPE:
+            return Secp256k1BatchVerifier(backend=backend)
         return BlsBatchVerifier(backend=backend)
     if backend is None or backend == "auto":
         backend = default_backend()

@@ -105,6 +105,25 @@ def lib() -> Optional[ctypes.CDLL]:
         ]
         cdll.sha512.restype = None
         cdll.sha512.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p]
+        try:
+            # newer symbol — a prebuilt .so from before it existed must
+            # still serve the WAL/packer paths (callers getattr-check)
+            cdll.commit_sign_bytes.restype = ctypes.c_int64
+            cdll.commit_sign_bytes.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,   # chain_id
+                ctypes.c_int64, ctypes.c_int64,    # height, round
+                ctypes.c_char_p, ctypes.c_int64,   # block id hash
+                ctypes.c_int64,                    # psh total
+                ctypes.c_char_p, ctypes.c_int64,   # psh hash
+                ctypes.c_char_p,                   # flags (n bytes)
+                ctypes.POINTER(ctypes.c_int64),    # ts seconds
+                ctypes.POINTER(ctypes.c_int64),    # ts nanos
+                ctypes.c_int64,                    # n
+                ctypes.c_char_p, ctypes.c_int64,   # out, cap
+                ctypes.POINTER(ctypes.c_int64),    # out offsets (n+1)
+            ]
+        except AttributeError:
+            pass
         _lib = cdll
         return _lib
 

@@ -1,0 +1,117 @@
+// Sanitizer stress driver for the BLS12-381 library, built and run under
+// ThreadSanitizer / AddressSanitizer by scripts/sanitize_native.sh.
+//
+// Exercises concurrent init (the std::call_once path), parallel
+// sign/verify/aggregate over shared inputs, and rejection paths
+// (tampered signatures, invalid encodings) — any data race, OOB access
+// or UB fails via the sanitizer's nonzero exit.
+//
+// Exit code 0 = no sanitizer report and all functional invariants held.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int bls_init();
+int bls_pubkey_from_sk(const uint8_t* sk, uint8_t* out96);
+int bls_sign(const uint8_t* sk, const uint8_t* msg, int64_t len, uint8_t* out96);
+int bls_verify(const uint8_t* pub, int64_t publen, const uint8_t* msg,
+               int64_t len, const uint8_t* sig96);
+int bls_aggregate_sigs(const uint8_t* sigs, int64_t n, uint8_t* out96);
+int bls_aggregate_verify(const uint8_t* pubs, const uint8_t* msgs,
+                         const int64_t* off, int64_t n, const uint8_t* sig96);
+}
+
+static std::atomic<int> failures{0};
+
+int main() {
+    const int NKEYS = 4;
+    const int NTHREADS = 4;
+
+    // concurrent first-touch: every thread races into ensure_init()
+    {
+        std::vector<std::thread> ts;
+        for (int i = 0; i < NTHREADS; i++)
+            ts.emplace_back([] {
+                if (bls_init() != 0) failures++;
+            });
+        for (auto& t : ts) t.join();
+    }
+    if (failures.load()) {
+        fprintf(stderr, "bls_init failed\n");
+        return 1;
+    }
+
+    uint8_t sks[NKEYS][32];
+    uint8_t pubs[NKEYS][96];
+    uint8_t msgs[NKEYS][24];
+    uint8_t sigs[NKEYS][96];
+    for (int i = 0; i < NKEYS; i++) {
+        memset(sks[i], 0x11 + i, 32);
+        sks[i][31] = (uint8_t)(i + 1);
+        if (bls_pubkey_from_sk(sks[i], pubs[i]) != 0) return 2;
+        snprintf((char*)msgs[i], sizeof(msgs[i]), "stress-msg-%d", i);
+        if (bls_sign(sks[i], msgs[i], (int64_t)strlen((char*)msgs[i]),
+                     sigs[i]) != 0)
+            return 3;
+    }
+
+    // parallel verify over shared (read-only) inputs + tamper rejection
+    {
+        std::vector<std::thread> ts;
+        for (int t = 0; t < NTHREADS; t++)
+            ts.emplace_back([&, t] {
+                for (int r = 0; r < 3; r++) {
+                    int i = (t + r) % NKEYS;
+                    int64_t ml = (int64_t)strlen((char*)msgs[i]);
+                    if (bls_verify(pubs[i], 96, msgs[i], ml, sigs[i]) != 1)
+                        failures++;
+                    uint8_t bad[96];
+                    memcpy(bad, sigs[i], 96);
+                    bad[95] ^= 1;
+                    if (bls_verify(pubs[i], 96, msgs[i], ml, bad) == 1)
+                        failures++;
+                    // structurally invalid: all-zero compressed point
+                    uint8_t zero[96] = {0};
+                    if (bls_verify(pubs[i], 96, msgs[i], ml, zero) == 1)
+                        failures++;
+                }
+            });
+        for (auto& t : ts) t.join();
+    }
+
+    // aggregate path (single thread; exercises scalar muls + product)
+    {
+        uint8_t cat_sigs[NKEYS * 96];
+        uint8_t cat_pubs[NKEYS * 96];
+        uint8_t cat_msgs[NKEYS * 24];
+        int64_t off[NKEYS + 1];
+        off[0] = 0;
+        for (int i = 0; i < NKEYS; i++) {
+            memcpy(cat_sigs + i * 96, sigs[i], 96);
+            memcpy(cat_pubs + i * 96, pubs[i], 96);
+            int64_t ml = (int64_t)strlen((char*)msgs[i]);
+            memcpy(cat_msgs + off[i], msgs[i], ml);
+            off[i + 1] = off[i] + ml;
+        }
+        uint8_t agg[96];
+        if (bls_aggregate_sigs(cat_sigs, NKEYS, agg) != 0) return 4;
+        if (bls_aggregate_verify(cat_pubs, cat_msgs, off, NKEYS, agg) != 1)
+            failures++;
+        cat_msgs[0] ^= 1;  // tamper one message -> reject
+        if (bls_aggregate_verify(cat_pubs, cat_msgs, off, NKEYS, agg) == 1)
+            failures++;
+    }
+
+    if (failures.load()) {
+        fprintf(stderr, "bls_stress: %d functional failures\n",
+                failures.load());
+        return 5;
+    }
+    printf("bls_stress: ok\n");
+    return 0;
+}

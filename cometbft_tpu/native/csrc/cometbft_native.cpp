@@ -365,3 +365,136 @@ void sha512(const uint8_t* data, int64_t len, uint8_t* out64) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Canonical precommit sign bytes (reference: types/canonical.go:57 +
+// types/vote.go:151; byte-exact mirror of types/canonical.py +
+// libs/protoenc.py — differential-tested in tests/test_native.py)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Buf {
+    uint8_t* p;
+    int64_t cap;
+    int64_t len;
+    bool overflow;
+    void put(uint8_t b) {
+        if (len >= cap) { overflow = true; return; }
+        p[len++] = b;
+    }
+    void put_bytes(const uint8_t* d, int64_t n) {
+        if (len + n > cap) { overflow = true; return; }
+        memcpy(p + len, d, n);
+        len += n;
+    }
+};
+
+static void put_uvarint(Buf& b, uint64_t n) {
+    while (true) {
+        uint8_t byte = n & 0x7F;
+        n >>= 7;
+        if (n) b.put(byte | 0x80);
+        else { b.put(byte); return; }
+    }
+}
+
+static void put_tag(Buf& b, int field, int wire) {
+    put_uvarint(b, (uint64_t)((field << 3) | wire));
+}
+
+// t_varint semantics: omitted when zero; negatives as 64-bit two's
+// complement (proto3 int64)
+static void put_t_varint(Buf& b, int field, int64_t v) {
+    if (v == 0) return;
+    put_tag(b, field, 0);
+    put_uvarint(b, (uint64_t)v);
+}
+
+static void put_t_sfixed64(Buf& b, int field, int64_t v) {
+    if (v == 0) return;
+    put_tag(b, field, 1);
+    uint64_t u = (uint64_t)v;
+    for (int i = 0; i < 8; i++) b.put((uint8_t)(u >> (8 * i)));
+}
+
+static void put_t_bytes(Buf& b, int field, const uint8_t* d, int64_t n) {
+    if (n <= 0) return;
+    put_tag(b, field, 2);
+    put_uvarint(b, (uint64_t)n);
+    b.put_bytes(d, n);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Sign bytes for every signature of one commit: the protoio
+// length-delimited CanonicalVote per validator.  All votes share
+// (chain_id, height, round, block_id); only the timestamp and the
+// block-id flag (2 = COMMIT -> block_id present; else nil -> omitted)
+// vary per lane.  ``out_off`` receives n+1 offsets into ``out``.
+// Returns total bytes written, or -1 when ``cap`` is too small.
+int64_t commit_sign_bytes(
+    const uint8_t* chain_id, int64_t chain_id_len,
+    int64_t height, int64_t round_,
+    const uint8_t* bid_hash, int64_t bid_hash_len,
+    int64_t psh_total, const uint8_t* psh_hash, int64_t psh_hash_len,
+    const uint8_t* flags, const int64_t* ts_s, const int64_t* ts_ns,
+    int64_t n, uint8_t* out, int64_t cap, int64_t* out_off) {
+    // canonical block id submessage (shared by every COMMIT-flag vote):
+    //   1: bytes hash, 2: message{1: varint total, 2: bytes hash}
+    uint8_t bid_buf[128];
+    Buf bid{bid_buf, (int64_t)sizeof(bid_buf), 0, false};
+    put_t_bytes(bid, 1, bid_hash, bid_hash_len);
+    {
+        uint8_t psh_buf[64];
+        Buf psh{psh_buf, (int64_t)sizeof(psh_buf), 0, false};
+        put_t_varint(psh, 1, psh_total);
+        put_t_bytes(psh, 2, psh_hash, psh_hash_len);
+        if (psh.overflow) return -1;
+        if (psh.len > 0) {  // t_message: omitted when empty
+            put_tag(bid, 2, 2);
+            put_uvarint(bid, (uint64_t)psh.len);
+            bid.put_bytes(psh_buf, psh.len);
+        }
+    }
+    if (bid.overflow) return -1;
+
+    Buf o{out, cap, 0, false};
+    for (int64_t i = 0; i < n; i++) {
+        out_off[i] = o.len;
+        // body assembled in a scratch buffer (max ~200B)
+        uint8_t body_buf[256];
+        Buf body{body_buf, (int64_t)sizeof(body_buf), 0, false};
+        put_t_varint(body, 1, 2);  // type = PRECOMMIT
+        put_t_sfixed64(body, 2, height);
+        put_t_sfixed64(body, 3, round_);
+        if (flags[i] == 2 && bid.len > 0) {  // BLOCK_ID_FLAG_COMMIT
+            put_tag(body, 4, 2);
+            put_uvarint(body, (uint64_t)bid.len);
+            body.put_bytes(bid_buf, bid.len);
+        }
+        {
+            uint8_t ts_buf[24];
+            Buf ts{ts_buf, (int64_t)sizeof(ts_buf), 0, false};
+            put_t_varint(ts, 1, ts_s[i]);
+            put_t_varint(ts, 2, ts_ns[i]);
+            if (ts.len > 0) {  // t_message: zero timestamp -> omitted
+                put_tag(body, 5, 2);
+                put_uvarint(body, (uint64_t)ts.len);
+                body.put_bytes(ts_buf, ts.len);
+            }
+        }
+        put_t_bytes(body, 6, chain_id, chain_id_len);
+        if (body.overflow) return -1;
+        // protoio delimited framing
+        put_uvarint(o, (uint64_t)body.len);
+        o.put_bytes(body_buf, body.len);
+        if (o.overflow) return -1;
+    }
+    out_off[n] = o.len;
+    return o.len;
+}
+
+}  // extern "C"

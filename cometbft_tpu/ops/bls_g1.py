@@ -1,15 +1,9 @@
 """Batched BLS12-381 G1 arithmetic and multi-scalar multiplication on TPU.
 
-Curve: y² = x³ + 4 over GF(P381), prime order subgroup r.  Points are
-PROJECTIVE (X : Y : Z) batches over ``ops.fp381`` Montgomery limbs, one
-point per TPU lane, with the COMPLETE addition formulas of
-Renes–Costello–Batina 2015 (algorithm 7 specialization for a = 0,
-b3 = 3·4 = 12): one branch-free formula valid for every input pair —
-doubling, mixed signs, and the identity (0 : 1 : 0) included.  No
-exceptional-case selects, no field equality tests, no per-lane flags —
-exactly what a SIMD lane needs (the Jacobian formulas the host oracle uses
-have exceptional cases that would each cost a canonical field comparison
-here).
+Curve: y² = x³ + 4 over GF(P381), prime order subgroup r — the shared
+complete-formula curve layer in ``ops.wcurve`` bound to the P381 field
+(see that module for the RCB15 projective formulas and the per-lane
+ladder design).
 
 The MSM axis is the validator set: aggregate/batched BLS verification
 reduces to Σ rᵢ·pkᵢ over 10k-validator sets (SURVEY §2.1.1; reference
@@ -23,213 +17,31 @@ from-spec); tests pin every op against it.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import NamedTuple, Optional, Sequence
+from typing import Optional, Sequence
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
 from cometbft_tpu.ops import fp381 as fp
+from cometbft_tpu.ops.wcurve import Curve, Point as G1, pack_scalar_bits
 
 B3 = 12  # 3·b for y² = x³ + 4
 
-# Fixed static-bounds signature for loop-carried coordinates: limbs at the
-# carry fixpoint (±1 slack), top limb and value within generous hulls that
-# every formula output re-enters after one carry (asserted in _fix).
-_LIMB_HULL = (fp.RED_LO - 2, fp.RED_HI + 2)
-_TOP_HULL = (-64, 64)
-_VAL_HULL = (-32 * fp.P_INT, 32 * fp.P_INT)
+_CURVE = Curve(fp._FIELD, B3)
+
+# point ops bound to the P381 curve (public API unchanged)
+fix_point = _CURVE.fix_point
+add = _CURVE.add
+double = _CURVE.double
+identity = _CURVE.identity
+select = _CURVE.select
+scalar_mul = _CURVE.scalar_mul
+lane_sum = _CURVE.lane_sum
+pack_points = _CURVE.pack_points
+unpack_points = _CURVE.unpack_points
 
 
-class G1(NamedTuple):
-    x: fp.F
-    y: fp.F
-    z: fp.F
-
-
-jax.tree_util.register_pytree_node(
-    G1, lambda p: ((p.x, p.y, p.z), None), lambda aux, ch: G1(*ch)
-)
-
-
-def _fix(a: fp.F) -> fp.F:
-    """Carry and clamp to the canonical static-bounds signature, so
-    loop-carried pytrees have identical aux data every iteration."""
-    a = fp.carry(a)
-    assert _LIMB_HULL[0] <= a.lo and a.hi <= _LIMB_HULL[1], (a.lo, a.hi)
-    assert _TOP_HULL[0] <= a.top_lo and a.top_hi <= _TOP_HULL[1], (
-        a.top_lo, a.top_hi,
-    )
-    assert _VAL_HULL[0] <= a.val_lo and a.val_hi <= _VAL_HULL[1], (
-        a.val_lo, a.val_hi,
-    )
-    return fp.F(a.v, *_LIMB_HULL, *_TOP_HULL, *_VAL_HULL)
-
-
-def fix_point(p: G1) -> G1:
-    return G1(_fix(p.x), _fix(p.y), _fix(p.z))
-
-
-def add(p: G1, q: G1) -> G1:
-    """Complete projective addition (RCB15 alg. 7, a=0): 12M + 2·(×b3)."""
-    x1, y1, z1 = p.x, p.y, p.z
-    x2, y2, z2 = q.x, q.y, q.z
-    t0 = fp.mul(x1, x2)
-    t1 = fp.mul(y1, y2)
-    t2 = fp.mul(z1, z2)
-    t3 = fp.mul(fp.add(x1, y1), fp.add(x2, y2))
-    t3 = fp.sub(t3, fp.add(t0, t1))  # X1Y2 + X2Y1
-    t4 = fp.mul(fp.add(y1, z1), fp.add(y2, z2))
-    t4 = fp.sub(t4, fp.add(t1, t2))  # Y1Z2 + Y2Z1
-    xz = fp.mul(fp.add(x1, z1), fp.add(x2, z2))
-    xz = fp.sub(xz, fp.add(t0, t2))  # X1Z2 + X2Z1
-    return _tail(t0, t1, t2, t3, t4, xz)
-
-
-def double(p: G1) -> G1:
-    """The same complete formula with squarings where operands coincide:
-    6S + 6M + 2·(×b3)."""
-    x1, y1, z1 = p.x, p.y, p.z
-    t0 = fp.square(x1)
-    t1 = fp.square(y1)
-    t2 = fp.square(z1)
-    t3 = fp.sub(fp.square(fp.add(x1, y1)), fp.add(t0, t1))  # 2XY
-    t4 = fp.sub(fp.square(fp.add(y1, z1)), fp.add(t1, t2))  # 2YZ
-    xz = fp.sub(fp.square(fp.add(x1, z1)), fp.add(t0, t2))  # 2XZ
-    return _tail(t0, t1, t2, t3, t4, xz)
-
-
-def _tail(t0, t1, t2, t3, t4, xz) -> G1:
-    """Shared tail of the complete a=0 formula."""
-    s0 = fp.add(fp.add(t0, t0), t0)  # 3·X1X2
-    t2 = fp.mul_small(t2, B3)
-    z3 = fp.add(t1, t2)
-    t1 = fp.sub(t1, t2)
-    y3 = fp.mul_small(xz, B3)
-    x3 = fp.sub(fp.mul(t3, t1), fp.mul(t4, y3))
-    y3m = fp.add(fp.mul(t1, z3), fp.mul(y3, s0))
-    z3m = fp.add(fp.mul(z3, t4), fp.mul(s0, t3))
-    return G1(x3, y3m, z3m)
-
-
-def identity(batch: int) -> G1:
-    """(0 : 1 : 0), exact limbs."""
-    return G1(
-        fp.pack([0] * batch),
-        fp.pack([1] * batch),
-        fp.pack([0] * batch),
-    )
-
-
-def select(bit: jnp.ndarray, a: G1, b: G1) -> G1:
-    """Per-lane select (bit: (B,) int/bool): a where bit else b.  Operands
-    must share the fixed bounds signature (call fix_point first)."""
-
-    def sel(u: fp.F, v: fp.F) -> fp.F:
-        assert (u.lo, u.hi, u.top_lo, u.top_hi, u.val_lo, u.val_hi) == (
-            v.lo, v.hi, v.top_lo, v.top_hi, v.val_lo, v.val_hi,
-        ), "select operands must be fixed first"
-        return fp.F(
-            jnp.where(bit[None, :] != 0, u.v, v.v),
-            u.lo, u.hi, u.top_lo, u.top_hi, u.val_lo, u.val_hi,
-        )
-
-    return G1(sel(a.x, b.x), sel(a.y, b.y), sel(a.z, b.z))
-
-
-def scalar_mul(base: G1, bits: jnp.ndarray) -> G1:
-    """Per-lane double-and-add, MSB first.  ``bits``: (nbits, B) int32 of
-    0/1.  Branch-free: the add always runs; the bit selects."""
-    base = fix_point(base)
-    nbits = bits.shape[0]
-    acc0 = fix_point(identity(bits.shape[1]))
-
-    def body(i, acc):
-        acc = fix_point(double(acc))
-        added = fix_point(add(acc, base))
-        bit = jax.lax.dynamic_slice_in_dim(bits, i, 1, axis=0)[0]
-        return select(bit, added, acc)
-
-    return jax.lax.fori_loop(0, nbits, body, acc0)
-
-
-def lane_sum(p: G1) -> G1:
-    """Fold the lane axis down to ONE point by pairwise complete adds —
-    log2(B) adds over halving widths.  Lanes must be padded to a power of
-    two with identity points by the caller (``pack_points`` does)."""
-    width = p.x.v.shape[1]
-    assert width & (width - 1) == 0, "lane_sum needs a power-of-two batch"
-    while width > 1:
-        half = width // 2
-
-        def halves(f: fp.F):
-            return (
-                fp.F(f.v[:, :half], *f[1:]),
-                fp.F(f.v[:, half:], *f[1:]),
-            )
-
-        ax, bx = halves(p.x)
-        ay, by = halves(p.y)
-        az, bz = halves(p.z)
-        p = fix_point(add(G1(ax, ay, az), G1(bx, by, bz)))
-        width = half
-    return p
-
-
-# ---------------------------------------------------------------------------
-# Host packing / unpacking.
-# ---------------------------------------------------------------------------
-
-def pack_points(points: Sequence[Optional[tuple]], batch: int | None = None) -> G1:
-    """Affine (x, y) int pairs (None = infinity) -> projective G1 batch,
-    padded with identity to ``batch`` (rounded up to a power of two)."""
-    n = len(points)
-    if batch is not None and batch < n:
-        raise ValueError(
-            f"batch {batch} would silently drop {n - batch} trailing points"
-        )
-    b = batch if batch is not None else n
-    b = 1 << max(b - 1, 0).bit_length() if b > 1 else 1  # next pow2
-    xs, ys, zs = [], [], []
-    for i in range(b):
-        pt = points[i] if i < n else None
-        if pt is None:
-            xs.append(0)
-            ys.append(1)
-            zs.append(0)
-        else:
-            xs.append(pt[0])
-            ys.append(pt[1])
-            zs.append(1)
-    return G1(fp.pack(xs), fp.pack(ys), fp.pack(zs))
-
-
-def unpack_points(p: G1) -> list:
-    """Projective batch -> affine (x, y) pairs / None (host bigints)."""
-    xs, ys, zs = fp.unpack(p.x), fp.unpack(p.y), fp.unpack(p.z)
-    out = []
-    for x, y, z in zip(xs, ys, zs):
-        if z == 0:
-            out.append(None)
-        else:
-            zi = pow(z, -1, fp.P_INT)
-            out.append(((x * zi) % fp.P_INT, (y * zi) % fp.P_INT))
-    return out
-
-
-def pack_scalar_bits(scalars: Sequence[int], nbits: int, batch: int) -> np.ndarray:
-    """(nbits, batch) int32 bit rows, MSB first; lanes past the scalar
-    list get 0 (×identity lanes from pack_points are harmless anyway)."""
-    out = np.zeros((nbits, batch), np.int32)
-    for j, s in enumerate(scalars):
-        assert 0 <= s < (1 << nbits), "scalar exceeds nbits"
-        for i in range(nbits):
-            out[nbits - 1 - i, j] = (s >> i) & 1
-    return out
-
-
-@partial(jax.jit, static_argnums=())
+@jax.jit
 def _msm_kernel(px, py, pz, bits):
     base = G1(px, py, pz)
     return lane_sum(scalar_mul(base, bits))

@@ -1,28 +1,13 @@
-"""Batched BLS12-381 base-field arithmetic in JAX, TPU-VPU style.
+"""Batched BLS12-381 base-field arithmetic: the fpgen limb machine bound
+to P381.
 
-Layout mirrors ``ops.fe25519``: a batch of GF(P381) elements is an int32
-array of shape ``(30, B)`` — 30 little-endian limbs of 13 bits each, batch
-on the TPU lane dimension, SIGNED lazily-reduced limbs with *static*
-bounds threaded through every op (trace-time interval analysis; the
-overflow discipline is machine-checked exactly as in fe25519).
-
-P381 is a general prime (no pseudo-Mersenne fold exists), so multiplication
-is **full-word Montgomery**: elements live in the Montgomery domain
-(value·R mod P, R = 2^390) and ``mul`` computes REDC(a·b) =
-
-    T  = a·b                      (59 schoolbook columns, VPU only)
-    m  = (T mod R)·N'  mod R      (low-half product, carries dropped at 30)
-    t  = (T + m·N) / R            (exact: low 390 bits cancel; the carry
-                                   out of them is one 30-step ripple)
-
-Two static bound systems compose here.  Per-limb intervals drive carry
-emission and int32-overflow checks, as in fe25519.  A per-element VALUE
-interval (the integer the limbs encode) rides along as well, because the
-top limb (weight 2^377) has no modulus fold to shrink it — only the REDC
-contraction does (t ≲ T/R + P/2, the classic Montgomery bound), and that
-contraction is a fact about *values*, invisible to per-limb analysis.
-``carry`` tightens the top-limb interval with the value-derived bound,
-which is what keeps repeated add→mul chains at a fixpoint.
+The algorithm and both static bound systems (per-limb intervals + the
+per-element value interval that drives the Montgomery contraction) live
+in ``ops.fpgen`` — one implementation serves every prime the framework
+uses (this module, and ``ops.fp256k1`` for secp256k1).  P381 is a general
+prime (no pseudo-Mersenne fold exists), hence full-word Montgomery:
+elements live in the Montgomery domain (value·R mod P, R = 2^390) and
+``mul`` computes column-REDC entirely from VPU adds/multiplies.
 
 Conversions to/from the Montgomery domain happen on the HOST (python
 bigints) when packing points — the device only ever multiplies.
@@ -37,377 +22,45 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
-import numpy as np
 import jax
-import jax.numpy as jnp
 
-NLIMBS = 30
-BITS = 13
-BASE = 1 << BITS
-HALF = BASE // 2
-MASK = BASE - 1
-NCOLS = 2 * NLIMBS  # 59 product columns + 1 accumulating pad
-TOP_SHIFT = BITS * (NLIMBS - 1)  # weight of the top limb: 2^377
+from cometbft_tpu.ops.fpgen import F, Field
 
 P_INT = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
-R_INT = 1 << (BITS * NLIMBS)  # 2^390
-R_MOD_P = R_INT % P_INT
-R2_MOD_P = (R_INT * R_INT) % P_INT
-R_INV = pow(R_INT, -1, P_INT)
-NPRIME = (-pow(P_INT, -1, R_INT)) % R_INT  # P * NPRIME ≡ -1 (mod R)
 
-# Reduced-limb fixpoint hull of the centered carry round: once limbs are
-# inside [-HALF-1, HALF], per-round carries are in {-1, 0, 1} and the hull
-# is stable (fe25519 has the same structure, widened there by FOLD).
-RED_LO, RED_HI = -(HALF + 1), HALF
-# int32 budget for a 30-term product column:
-_I32_LIMIT = 2**31 - 1 - HALF
-
-
-class F(NamedTuple):
-    """A batch of field elements: (30, B) int32 limbs + static bounds.
-
-    ``lo/hi``: hull of limbs 0..28.  ``top_lo/top_hi``: hull of limb 29
-    (it accumulates carries; no fold exists at weight 2^390).
-    ``val_lo/val_hi``: hull of the encoded integer value — the handle the
-    Montgomery contraction argument needs (see module docstring)."""
-
-    v: jnp.ndarray
-    lo: int
-    hi: int
-    top_lo: int
-    top_hi: int
-    val_lo: int
-    val_hi: int
-
-    @property
-    def absmax(self) -> int:
-        return max(abs(self.lo), abs(self.hi), abs(self.top_lo), abs(self.top_hi))
-
-
-jax.tree_util.register_pytree_node(
-    F,
-    lambda f: ((f.v,), (f.lo, f.hi, f.top_lo, f.top_hi, f.val_lo, f.val_hi)),
-    lambda aux, ch: F(ch[0], *aux),
-)
-
-
-# ---------------------------------------------------------------------------
-# Host helpers.
-# ---------------------------------------------------------------------------
-
-def limbs_of_int(n: int, nlimbs: int = NLIMBS) -> np.ndarray:
-    out = np.zeros(nlimbs, np.int64)
-    for i in range(nlimbs):
-        out[i] = n & MASK
-        n >>= BITS
-    assert n == 0, "value does not fit"
-    return out.astype(np.int32)
-
-
-def int_of_limbs(x) -> int:
-    n = 0
-    for i in reversed(range(len(x))):
-        n = (n << BITS) + int(x[i])
-    return n
-
-
-def to_mont(n: int) -> int:
-    """Canonical int -> Montgomery representative (host packing)."""
-    return (n * R_MOD_P) % P_INT
-
-
-def from_mont(n: int) -> int:
-    """Montgomery representative (any signed value) -> canonical int."""
-    return (n * R_INV) % P_INT
-
-
-def pack(vals, batch: int | None = None) -> "F":
-    """Host: list of canonical ints -> Montgomery-domain F batch."""
-    b = batch if batch is not None else len(vals)
-    arr = np.zeros((NLIMBS, b), np.int32)
-    for j, n in enumerate(vals):
-        arr[:, j] = limbs_of_int(to_mont(n % P_INT))
-    return F(jnp.asarray(arr), 0, MASK, 0, MASK, 0, P_INT - 1)
-
-
-def unpack(f: "F") -> list:
-    """Device F batch -> canonical ints (host; handles signed lazy limbs)."""
-    arr = np.asarray(f.v)
-    return [from_mont(int_of_limbs(arr[:, j])) for j in range(arr.shape[1])]
-
-
-_N_LIMBS_CONST = limbs_of_int(P_INT)
-_NPRIME_LIMBS = limbs_of_int(NPRIME)
-
-
-def _rows_const(limbs, batch: int) -> jnp.ndarray:
-    return jnp.concatenate(
-        [jnp.full((1, batch), int(l), jnp.int32) for l in limbs], axis=0
-    )
-
-
-def const(n: int, batch: int = 1) -> F:
-    """Montgomery-domain constant broadcastable over the batch."""
-    m = to_mont(n % P_INT)
-    return F(_rows_const(limbs_of_int(m), batch), 0, MASK, 0, MASK, m, m)
-
-
-def zero_like(a: F) -> F:
-    return F(jnp.zeros_like(a.v), 0, 0, 0, 0, 0, 0)
-
-
-# ---------------------------------------------------------------------------
-# Carry machinery (interval-driven, accumulating top limb).
-# ---------------------------------------------------------------------------
-
-def _top_hull_from_val(val_lo: int, val_hi: int, limb_absmax: int):
-    """Top-limb hull implied by the value hull: value = top·2^377 + rest,
-    |rest| <= limb_absmax · Σ_{i<29} 2^13i < limb_absmax · 2^364.1."""
-    slack = limb_absmax // MASK + 2
-    return (val_lo >> TOP_SHIFT) - slack, (val_hi >> TOP_SHIFT) + slack
-
-
-def _sim_carry(bounds: list, accumulate_top: bool) -> tuple[int, list]:
-    """Interval simulation of repeated ``_carry_once`` over ``len(bounds)``
-    limbs.  With ``accumulate_top`` the last limb absorbs incoming carries
-    and never emits one; without it the top carry is DROPPED (mod-2^(13n)
-    semantics, used for m)."""
-    n = len(bounds)
-    rounds = 0
-    while (
-        min(l for l, _ in bounds[:-1]) < RED_LO
-        or max(h for _, h in bounds[:-1]) > RED_HI
-        or (not accumulate_top and (bounds[-1][0] < RED_LO or bounds[-1][1] > RED_HI))
-    ):
-        assert -(2**31) < bounds[-1][0] and bounds[-1][1] < 2**31, (
-            "top-limb accumulation overflow"
-        )
-        c = [((l + HALF) >> BITS, (h + HALF) >> BITS) for l, h in bounds]
-        nb = []
-        for i in range(n):
-            cin = (0, 0) if i == 0 else c[i - 1]
-            if i == n - 1 and accumulate_top:
-                nb.append((bounds[i][0] + cin[0], bounds[i][1] + cin[1]))
-            else:
-                nb.append((-HALF + cin[0], HALF - 1 + cin[1]))
-        bounds = nb
-        rounds += 1
-        assert rounds <= 8, "carry interval analysis diverged"
-    return rounds, bounds
-
-
-def _carry_once(v: jnp.ndarray, accumulate_top: bool) -> jnp.ndarray:
-    c = (v + HALF) >> BITS
-    r = v - (c << BITS)
-    carry_in = jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
-    if accumulate_top:
-        # top limb keeps its full value and absorbs the incoming carry
-        r = jnp.concatenate([r[:-1], v[-1:]], axis=0)
-    return r + carry_in
-
-
-def carry(a: F) -> F:
-    """Reduce limbs to the centered fixpoint.  The top-limb hull is
-    tightened with the value-derived bound — the only mechanism that ever
-    SHRINKS it (values contract through REDC, not through carrying)."""
-    tl, th = a.top_lo, a.top_hi
-    vtl, vth = _top_hull_from_val(a.val_lo, a.val_hi, max(abs(a.lo), abs(a.hi)))
-    tl, th = max(tl, vtl), min(th, vth)
-    bounds = [(a.lo, a.hi)] * (NLIMBS - 1) + [(tl, th)]
-    rounds, bounds = _sim_carry(bounds, accumulate_top=True)
-    v = a.v
-    for _ in range(rounds):
-        v = _carry_once(v, accumulate_top=True)
-    lo = min(l for l, _ in bounds[:-1])
-    hi = max(h for _, h in bounds[:-1])
-    return F(v, lo, hi, bounds[-1][0], bounds[-1][1], a.val_lo, a.val_hi)
-
-
-# ---------------------------------------------------------------------------
-# Ring ops.
-# ---------------------------------------------------------------------------
-
-def add(a: F, b: F) -> F:
-    lo, hi = a.lo + b.lo, a.hi + b.hi
-    tl, th = a.top_lo + b.top_lo, a.top_hi + b.top_hi
-    assert -(2**31) < min(lo, tl) and max(hi, th) < 2**31, "add overflow"
-    return F(a.v + b.v, lo, hi, tl, th, a.val_lo + b.val_lo, a.val_hi + b.val_hi)
-
-
-def sub(a: F, b: F) -> F:
-    lo, hi = a.lo - b.hi, a.hi - b.lo
-    tl, th = a.top_lo - b.top_hi, a.top_hi - b.top_lo
-    assert -(2**31) < min(lo, tl) and max(hi, th) < 2**31, "sub overflow"
-    return F(a.v - b.v, lo, hi, tl, th, a.val_lo - b.val_hi, a.val_hi - b.val_lo)
-
-
-def neg(a: F) -> F:
-    return F(-a.v, -a.hi, -a.lo, -a.top_hi, -a.top_lo, -a.val_hi, -a.val_lo)
-
-
-def mul_small(a: F, k: int) -> F:
-    assert k >= 0
-    lo, hi = a.lo * k, a.hi * k
-    tl, th = a.top_lo * k, a.top_hi * k
-    assert -(2**31) < min(lo, tl) and max(hi, th) < 2**31
-    return F(a.v * k, lo, hi, tl, th, a.val_lo * k, a.val_hi * k)
-
-
-def _cols_skew(av: jnp.ndarray, bv: jnp.ndarray) -> jnp.ndarray:
-    """(60, B) product columns of two (30, B) limb arrays via the
-    skew-reshape (same construction as fe25519._cols_skew)."""
-    n = NLIMBS
-    B = av.shape[1]
-    prod = av[:, None, :] * bv[None, :, :]
-    z = jnp.pad(prod, ((0, 0), (0, n), (0, 0)))
-    skew = z.reshape(2 * n * n, B)[: n * (2 * n - 1)].reshape(n, 2 * n - 1, B)
-    cols = jnp.sum(skew, axis=0)  # (59, B)
-    return jnp.concatenate([cols, jnp.zeros((1, B), cols.dtype)], axis=0)
-
-
-def _cols_sq(av: jnp.ndarray) -> jnp.ndarray:
-    """(60, B) columns of a^2 via the symmetric half-triangle (sublane
-    shifted-row placement; ~465 limb products instead of 900)."""
-    n = NLIMBS
-    B = av.shape[1]
-    a2 = av * 2
-    acc = None
-    for j in range(n):
-        head = av[j : j + 1] * av[j][None, :]
-        if j + 1 < n:
-            prod = jnp.concatenate([head, a2[j + 1 :] * av[j][None, :]])
-        else:
-            prod = head
-        parts = [] if j == 0 else [jnp.zeros((2 * j, B), av.dtype)]
-        parts += [prod, jnp.zeros((n - j, B), av.dtype)]
-        step = jnp.concatenate(parts, axis=0)
-        acc = step if acc is None else acc + step
-    return acc
-
-
-def _prod_col_bounds(amax: int, bmax: int) -> list:
-    """Exact per-column interval for a 30x30 schoolbook column array."""
-    out = []
-    for k in range(NCOLS - 1):
-        terms = min(k + 1, NCOLS - 1 - k, NLIMBS)
-        out.append((-terms * amax * bmax, terms * amax * bmax))
-    out.append((0, 0))  # pad column
-    return out
-
-
-def _carry_cols(cols: jnp.ndarray, bounds: list, accumulate_top: bool):
-    """Parallel-carry a column array per its interval analysis."""
-    rounds, bounds = _sim_carry(bounds, accumulate_top)
-    for _ in range(rounds):
-        cols = _carry_once(cols, accumulate_top)
-    return cols, bounds
-
-
-def _redc(cols: jnp.ndarray, bounds: list, val_lo: int, val_hi: int) -> F:
-    """Montgomery reduction of a (60, B) column array -> F.
-
-    ``bounds`` are per-column intervals, ``val_lo/val_hi`` the interval of
-    the encoded integer T; the result encodes (T + m·N)/R ≡ T·R^{-1}
-    (mod P) with both bound systems tracked."""
-    B = cols.shape[1]
-    # stage A: carry the 60-column array (top accumulates)
-    cols, bounds = _carry_cols(cols, bounds, accumulate_top=True)
-
-    # m = (T_lo · N') mod R  — columns 0..29 only, carries dropped at 30
-    t_lo = cols[:NLIMBS]
-    np_rows = _rows_const(_NPRIME_LIMBS, 1)
-    m_cols = None
-    tmax = max(max(abs(l), abs(h)) for l, h in bounds[:NLIMBS])
-    for j in range(NLIMBS):
-        # row j of the low-half schoolbook: N'_j · T_lo[0:30-j] at cols j..29
-        prod = t_lo[: NLIMBS - j] * np_rows[j][None, :]
-        parts = [prod] if j == 0 else [jnp.zeros((j, B), cols.dtype), prod]
-        step = jnp.concatenate(parts, axis=0)
-        m_cols = step if m_cols is None else m_cols + step
-    m_bounds = [
-        (-(k + 1) * tmax * MASK, (k + 1) * tmax * MASK) for k in range(NLIMBS)
-    ]
-    for l, h in m_bounds:
-        assert -(2**31) < l and h < 2**31, "m column overflow"
-    # mod-R carry: the top limb does NOT accumulate; its carry is dropped
-    m, m_bounds = _carry_cols(m_cols, m_bounds, accumulate_top=False)
-    mmax = max(max(abs(l), abs(h)) for l, h in m_bounds)
-    # |value(m)| <= mmax * (2^390-1)/(2^13-1)
-    m_val_max = mmax * ((R_INT - 1) // MASK)
-
-    # T + m·N over the full 60 columns
-    n_rows = _rows_const(_N_LIMBS_CONST, 1)
-    mn = None
-    for j in range(NLIMBS):
-        prod = m * n_rows[j][None, :]  # (30, B), shifted to cols j..j+29
-        parts = [] if j == 0 else [jnp.zeros((j, B), cols.dtype)]
-        parts += [prod, jnp.zeros((NLIMBS - j, B), cols.dtype)]
-        step = jnp.concatenate(parts, axis=0)
-        mn = step if mn is None else mn + step
-    total = cols + mn
-    tb = []
-    for k in range(NCOLS):
-        terms = min(k + 1, NCOLS - 1 - k, NLIMBS)
-        l = bounds[k][0] - terms * mmax * MASK
-        h = bounds[k][1] + terms * mmax * MASK
-        assert -(2**31) < l and h < 2**31, "T+mN column overflow"
-        tb.append((l, h))
-
-    # exact low ripple: value(total[:30]) ≡ 0 (mod R); fold its carry out
-    # into column 30.  30 unrolled (1, B) shift-adds; the remainder limbs
-    # are exactly zero by construction and are dropped.
-    cin = jnp.zeros((1, B), cols.dtype)
-    cin_lo = cin_hi = 0
-    for i in range(NLIMBS):
-        s_lo, s_hi = tb[i][0] + cin_lo, tb[i][1] + cin_hi
-        assert -(2**31) < s_lo and s_hi < 2**31, "ripple overflow"
-        cin = (total[i : i + 1] + cin) >> BITS
-        cin_lo, cin_hi = s_lo >> BITS, s_hi >> BITS
-
-    t = total[NLIMBS:]
-    t = jnp.concatenate([t[:1] + cin, t[1:]], axis=0)
-    t_bounds = [
-        (tb[NLIMBS][0] + cin_lo, tb[NLIMBS][1] + cin_hi)
-    ] + tb[NLIMBS + 1 :]
-    # value(t) = (T + m·N)/R  — the Montgomery contraction
-    out_val_lo = (val_lo - m_val_max * P_INT) // R_INT - 1
-    out_val_hi = (val_hi + m_val_max * P_INT) // R_INT + 1
-    out = F(
-        t,
-        min(l for l, _ in t_bounds[:-1]),
-        max(h for _, h in t_bounds[:-1]),
-        t_bounds[-1][0],
-        t_bounds[-1][1],
-        out_val_lo,
-        out_val_hi,
-    )
-    return carry(out)
-
-
-def mul(a: F, b: F) -> F:
-    """Montgomery product REDC(a·b) — the F381 ring multiply."""
-    if a is b:
-        return square(a)
-    while NLIMBS * a.absmax * b.absmax >= _I32_LIMIT:
-        a, b = (carry(a), b) if a.absmax >= b.absmax else (a, carry(b))
-    cols = _cols_skew(a.v, b.v)
-    vals = [
-        a.val_lo * b.val_lo, a.val_lo * b.val_hi,
-        a.val_hi * b.val_lo, a.val_hi * b.val_hi,
-    ]
-    return _redc(
-        cols, _prod_col_bounds(a.absmax, b.absmax), min(vals), max(vals)
-    )
-
-
-def square(a: F) -> F:
-    while NLIMBS * a.absmax * a.absmax >= _I32_LIMIT:
-        a = carry(a)
-    vals = [a.val_lo * a.val_lo, a.val_lo * a.val_hi, a.val_hi * a.val_hi]
-    return _redc(
-        _cols_sq(a.v), _prod_col_bounds(a.absmax, a.absmax), min(vals), max(vals)
-    )
+_FIELD = Field(P_INT, nlimbs=30, bits=13)
+
+# -- constants re-exported for consumers/tests ------------------------------
+NLIMBS = _FIELD.NLIMBS
+BITS = _FIELD.BITS
+BASE = _FIELD.BASE
+HALF = _FIELD.HALF
+MASK = _FIELD.MASK
+NCOLS = _FIELD.NCOLS
+TOP_SHIFT = _FIELD.TOP_SHIFT
+R_INT = _FIELD.R_INT
+R_MOD_P = _FIELD.R_MOD_P
+R2_MOD_P = _FIELD.R2_MOD_P
+R_INV = _FIELD.R_INV
+NPRIME = _FIELD.NPRIME
+RED_LO, RED_HI = _FIELD.RED_LO, _FIELD.RED_HI
+
+# -- ops bound to the P381 instance -----------------------------------------
+limbs_of_int = _FIELD.limbs_of_int
+int_of_limbs = _FIELD.int_of_limbs
+to_mont = _FIELD.to_mont
+from_mont = _FIELD.from_mont
+pack = _FIELD.pack
+unpack = _FIELD.unpack
+const = _FIELD.const
+zero_like = _FIELD.zero_like
+carry = _FIELD.carry
+add = _FIELD.add
+sub = _FIELD.sub
+neg = _FIELD.neg
+mul_small = _FIELD.mul_small
+mul = _FIELD.mul
+square = _FIELD.square
 
 
 # ---------------------------------------------------------------------------

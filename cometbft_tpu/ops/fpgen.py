@@ -1,0 +1,403 @@
+"""Parameterized batched prime-field arithmetic in JAX, TPU-VPU style.
+
+This is the general-prime Montgomery limb machine described in
+``ops.fp381`` (see that module's docstring for the algorithm and the
+two-level static bound system), factored out so ONE implementation
+serves every prime the framework needs:
+
+    fp381.py        binds Field(P381, nlimbs=30, bits=13)    (BLS12-381)
+    secp_verify.py  binds Field(P256K1, nlimbs=21, bits=13)  (secp256k1,
+                    BASELINE config #4; 21 not 20 — the curve layer
+                    requires R/P >= 2^9 of Montgomery headroom)
+
+A batch of GF(P) elements is an int32 array of shape ``(NLIMBS, B)`` —
+little-endian ``BITS``-bit limbs, batch on the TPU lane dimension, SIGNED
+lazily-reduced limbs with *static* bounds threaded through every op
+(trace-time interval analysis).  Elements live in the Montgomery domain
+(value·R mod P, R = 2^(BITS·NLIMBS)); ``mul`` is CIOS-free column REDC
+built entirely from VPU adds/multiplies.
+
+Reference behavior being re-derived (not translated): the native field
+backends the reference links (blst for BLS12-381, crypto/secp256k1 via
+btcec) — here re-designed for the TPU's 8x128 vector unit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class F(NamedTuple):
+    """A batch of field elements: (NLIMBS, B) int32 limbs + static bounds.
+
+    ``lo/hi``: hull of limbs 0..NLIMBS-2.  ``top_lo/top_hi``: hull of the
+    top limb (it accumulates carries; no fold exists at weight R).
+    ``val_lo/val_hi``: hull of the encoded integer value — the handle the
+    Montgomery contraction argument needs (see ops.fp381 docstring)."""
+
+    v: jnp.ndarray
+    lo: int
+    hi: int
+    top_lo: int
+    top_hi: int
+    val_lo: int
+    val_hi: int
+
+    @property
+    def absmax(self) -> int:
+        return max(abs(self.lo), abs(self.hi), abs(self.top_lo), abs(self.top_hi))
+
+
+jax.tree_util.register_pytree_node(
+    F,
+    lambda f: ((f.v,), (f.lo, f.hi, f.top_lo, f.top_hi, f.val_lo, f.val_hi)),
+    lambda aux, ch: F(ch[0], *aux),
+)
+
+
+class Field:
+    """All field ops bound to one (P, NLIMBS, BITS) configuration."""
+
+    def __init__(self, p: int, nlimbs: int, bits: int):
+        assert p % 2 == 1 and p.bit_length() <= nlimbs * bits
+        self.P_INT = p
+        self.NLIMBS = nlimbs
+        self.BITS = bits
+        self.BASE = 1 << bits
+        self.HALF = self.BASE // 2
+        self.MASK = self.BASE - 1
+        self.NCOLS = 2 * nlimbs
+        self.TOP_SHIFT = bits * (nlimbs - 1)
+        self.R_INT = 1 << (bits * nlimbs)
+        self.R_MOD_P = self.R_INT % p
+        self.R2_MOD_P = (self.R_INT * self.R_INT) % p
+        self.R_INV = pow(self.R_INT, -1, p)
+        self.NPRIME = (-pow(p, -1, self.R_INT)) % self.R_INT
+        # Reduced-limb fixpoint hull of the centered carry round.
+        self.RED_LO, self.RED_HI = -(self.HALF + 1), self.HALF
+        self._I32_LIMIT = 2**31 - 1 - self.HALF
+        self._N_LIMBS_CONST = self.limbs_of_int(p)
+        self._NPRIME_LIMBS = self.limbs_of_int(self.NPRIME)
+
+    # -- host helpers ------------------------------------------------------
+
+    def limbs_of_int(self, n: int, nlimbs: int | None = None) -> np.ndarray:
+        nlimbs = nlimbs if nlimbs is not None else self.NLIMBS
+        out = np.zeros(nlimbs, np.int64)
+        for i in range(nlimbs):
+            out[i] = n & self.MASK
+            n >>= self.BITS
+        assert n == 0, "value does not fit"
+        return out.astype(np.int32)
+
+    def int_of_limbs(self, x) -> int:
+        n = 0
+        for i in reversed(range(len(x))):
+            n = (n << self.BITS) + int(x[i])
+        return n
+
+    def to_mont(self, n: int) -> int:
+        """Canonical int -> Montgomery representative (host packing)."""
+        return (n * self.R_MOD_P) % self.P_INT
+
+    def from_mont(self, n: int) -> int:
+        """Montgomery representative (any signed value) -> canonical int."""
+        return (n * self.R_INV) % self.P_INT
+
+    def pack(self, vals, batch: int | None = None) -> F:
+        """Host: list of canonical ints -> Montgomery-domain F batch."""
+        b = batch if batch is not None else len(vals)
+        arr = np.zeros((self.NLIMBS, b), np.int32)
+        for j, n in enumerate(vals):
+            arr[:, j] = self.limbs_of_int(self.to_mont(n % self.P_INT))
+        return F(jnp.asarray(arr), 0, self.MASK, 0, self.MASK, 0, self.P_INT - 1)
+
+    def unpack(self, f: F) -> list:
+        """Device F batch -> canonical ints (handles signed lazy limbs)."""
+        arr = np.asarray(f.v)
+        return [
+            self.from_mont(self.int_of_limbs(arr[:, j]))
+            for j in range(arr.shape[1])
+        ]
+
+    def _rows_const(self, limbs, batch: int) -> jnp.ndarray:
+        return jnp.concatenate(
+            [jnp.full((1, batch), int(l), jnp.int32) for l in limbs], axis=0
+        )
+
+    def const(self, n: int, batch: int = 1) -> F:
+        """Montgomery-domain constant broadcastable over the batch."""
+        m = self.to_mont(n % self.P_INT)
+        return F(
+            self._rows_const(self.limbs_of_int(m), batch),
+            0, self.MASK, 0, self.MASK, m, m,
+        )
+
+    def zero_like(self, a: F) -> F:
+        return F(jnp.zeros_like(a.v), 0, 0, 0, 0, 0, 0)
+
+    # -- carry machinery (interval-driven, accumulating top limb) ----------
+
+    def _top_hull_from_val(self, val_lo: int, val_hi: int, limb_absmax: int):
+        """Top-limb hull implied by the value hull: value = top·2^TOP_SHIFT
+        + rest, |rest| <= limb_absmax · Σ_{i<NLIMBS-1} BASE^i."""
+        slack = limb_absmax // self.MASK + 2
+        return (
+            (val_lo >> self.TOP_SHIFT) - slack,
+            (val_hi >> self.TOP_SHIFT) + slack,
+        )
+
+    def _sim_carry(self, bounds: list, accumulate_top: bool) -> tuple[int, list]:
+        """Interval simulation of repeated ``_carry_once`` over
+        ``len(bounds)`` limbs.  With ``accumulate_top`` the last limb
+        absorbs incoming carries and never emits one; without it the top
+        carry is DROPPED (mod-R semantics, used for m)."""
+        n = len(bounds)
+        RED_LO, RED_HI, HALF, BITS = (
+            self.RED_LO, self.RED_HI, self.HALF, self.BITS
+        )
+        rounds = 0
+        while (
+            min(l for l, _ in bounds[:-1]) < RED_LO
+            or max(h for _, h in bounds[:-1]) > RED_HI
+            or (not accumulate_top
+                and (bounds[-1][0] < RED_LO or bounds[-1][1] > RED_HI))
+        ):
+            assert -(2**31) < bounds[-1][0] and bounds[-1][1] < 2**31, (
+                "top-limb accumulation overflow"
+            )
+            c = [((l + HALF) >> BITS, (h + HALF) >> BITS) for l, h in bounds]
+            nb = []
+            for i in range(n):
+                cin = (0, 0) if i == 0 else c[i - 1]
+                if i == n - 1 and accumulate_top:
+                    nb.append((bounds[i][0] + cin[0], bounds[i][1] + cin[1]))
+                else:
+                    nb.append((-HALF + cin[0], HALF - 1 + cin[1]))
+            bounds = nb
+            rounds += 1
+            assert rounds <= 8, "carry interval analysis diverged"
+        return rounds, bounds
+
+    def _carry_once(self, v: jnp.ndarray, accumulate_top: bool) -> jnp.ndarray:
+        c = (v + self.HALF) >> self.BITS
+        r = v - (c << self.BITS)
+        carry_in = jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+        if accumulate_top:
+            # top limb keeps its full value and absorbs the incoming carry
+            r = jnp.concatenate([r[:-1], v[-1:]], axis=0)
+        return r + carry_in
+
+    def carry(self, a: F) -> F:
+        """Reduce limbs to the centered fixpoint.  The top-limb hull is
+        tightened with the value-derived bound — the only mechanism that
+        ever SHRINKS it (values contract through REDC, not carrying)."""
+        tl, th = a.top_lo, a.top_hi
+        vtl, vth = self._top_hull_from_val(
+            a.val_lo, a.val_hi, max(abs(a.lo), abs(a.hi))
+        )
+        tl, th = max(tl, vtl), min(th, vth)
+        bounds = [(a.lo, a.hi)] * (self.NLIMBS - 1) + [(tl, th)]
+        rounds, bounds = self._sim_carry(bounds, accumulate_top=True)
+        v = a.v
+        for _ in range(rounds):
+            v = self._carry_once(v, accumulate_top=True)
+        lo = min(l for l, _ in bounds[:-1])
+        hi = max(h for _, h in bounds[:-1])
+        return F(v, lo, hi, bounds[-1][0], bounds[-1][1], a.val_lo, a.val_hi)
+
+    # -- ring ops ----------------------------------------------------------
+
+    def add(self, a: F, b: F) -> F:
+        lo, hi = a.lo + b.lo, a.hi + b.hi
+        tl, th = a.top_lo + b.top_lo, a.top_hi + b.top_hi
+        assert -(2**31) < min(lo, tl) and max(hi, th) < 2**31, "add overflow"
+        return F(
+            a.v + b.v, lo, hi, tl, th,
+            a.val_lo + b.val_lo, a.val_hi + b.val_hi,
+        )
+
+    def sub(self, a: F, b: F) -> F:
+        lo, hi = a.lo - b.hi, a.hi - b.lo
+        tl, th = a.top_lo - b.top_hi, a.top_hi - b.top_lo
+        assert -(2**31) < min(lo, tl) and max(hi, th) < 2**31, "sub overflow"
+        return F(
+            a.v - b.v, lo, hi, tl, th,
+            a.val_lo - b.val_hi, a.val_hi - b.val_lo,
+        )
+
+    def neg(self, a: F) -> F:
+        return F(-a.v, -a.hi, -a.lo, -a.top_hi, -a.top_lo, -a.val_hi, -a.val_lo)
+
+    def mul_small(self, a: F, k: int) -> F:
+        assert k >= 0
+        lo, hi = a.lo * k, a.hi * k
+        tl, th = a.top_lo * k, a.top_hi * k
+        assert -(2**31) < min(lo, tl) and max(hi, th) < 2**31
+        return F(a.v * k, lo, hi, tl, th, a.val_lo * k, a.val_hi * k)
+
+    # -- multiplication columns -------------------------------------------
+
+    def _cols_skew(self, av: jnp.ndarray, bv: jnp.ndarray) -> jnp.ndarray:
+        """(2n, B) product columns of two (n, B) limb arrays via the
+        skew-reshape (same construction as fe25519._cols_skew)."""
+        n = self.NLIMBS
+        B = av.shape[1]
+        prod = av[:, None, :] * bv[None, :, :]
+        z = jnp.pad(prod, ((0, 0), (0, n), (0, 0)))
+        skew = z.reshape(2 * n * n, B)[: n * (2 * n - 1)].reshape(
+            n, 2 * n - 1, B
+        )
+        cols = jnp.sum(skew, axis=0)  # (2n-1, B)
+        return jnp.concatenate([cols, jnp.zeros((1, B), cols.dtype)], axis=0)
+
+    def _cols_sq(self, av: jnp.ndarray) -> jnp.ndarray:
+        """(2n, B) columns of a^2 via the symmetric half-triangle (sublane
+        shifted-row placement; ~n(n+1)/2 limb products instead of n^2)."""
+        n = self.NLIMBS
+        B = av.shape[1]
+        a2 = av * 2
+        acc = None
+        for j in range(n):
+            head = av[j : j + 1] * av[j][None, :]
+            if j + 1 < n:
+                prod = jnp.concatenate([head, a2[j + 1 :] * av[j][None, :]])
+            else:
+                prod = head
+            parts = [] if j == 0 else [jnp.zeros((2 * j, B), av.dtype)]
+            parts += [prod, jnp.zeros((n - j, B), av.dtype)]
+            step = jnp.concatenate(parts, axis=0)
+            acc = step if acc is None else acc + step
+        return acc
+
+    def _prod_col_bounds(self, amax: int, bmax: int) -> list:
+        """Exact per-column interval for an n x n schoolbook column array."""
+        out = []
+        for k in range(self.NCOLS - 1):
+            terms = min(k + 1, self.NCOLS - 1 - k, self.NLIMBS)
+            out.append((-terms * amax * bmax, terms * amax * bmax))
+        out.append((0, 0))  # pad column
+        return out
+
+    def _carry_cols(self, cols: jnp.ndarray, bounds: list, accumulate_top: bool):
+        """Parallel-carry a column array per its interval analysis."""
+        rounds, bounds = self._sim_carry(bounds, accumulate_top)
+        for _ in range(rounds):
+            cols = self._carry_once(cols, accumulate_top)
+        return cols, bounds
+
+    def _redc(self, cols: jnp.ndarray, bounds: list, val_lo: int, val_hi: int) -> F:
+        """Montgomery reduction of a (2n, B) column array -> F.
+
+        ``bounds`` are per-column intervals, ``val_lo/val_hi`` the interval
+        of the encoded integer T; the result encodes (T + m·N)/R ≡ T·R^{-1}
+        (mod P) with both bound systems tracked."""
+        NLIMBS, NCOLS, MASK, BITS = (
+            self.NLIMBS, self.NCOLS, self.MASK, self.BITS
+        )
+        B = cols.shape[1]
+        # stage A: carry the column array (top accumulates)
+        cols, bounds = self._carry_cols(cols, bounds, accumulate_top=True)
+
+        # m = (T_lo · N') mod R  — low columns only, carries dropped at n
+        t_lo = cols[:NLIMBS]
+        np_rows = self._rows_const(self._NPRIME_LIMBS, 1)
+        m_cols = None
+        tmax = max(max(abs(l), abs(h)) for l, h in bounds[:NLIMBS])
+        for j in range(NLIMBS):
+            # row j of the low-half schoolbook: N'_j · T_lo[0:n-j] at j..n-1
+            prod = t_lo[: NLIMBS - j] * np_rows[j][None, :]
+            parts = [prod] if j == 0 else [jnp.zeros((j, B), cols.dtype), prod]
+            step = jnp.concatenate(parts, axis=0)
+            m_cols = step if m_cols is None else m_cols + step
+        m_bounds = [
+            (-(k + 1) * tmax * MASK, (k + 1) * tmax * MASK)
+            for k in range(NLIMBS)
+        ]
+        for l, h in m_bounds:
+            assert -(2**31) < l and h < 2**31, "m column overflow"
+        # mod-R carry: the top limb does NOT accumulate; carry is dropped
+        m, m_bounds = self._carry_cols(m_cols, m_bounds, accumulate_top=False)
+        mmax = max(max(abs(l), abs(h)) for l, h in m_bounds)
+        # |value(m)| <= mmax * (R-1)/(BASE-1)
+        m_val_max = mmax * ((self.R_INT - 1) // MASK)
+
+        # T + m·N over the full 2n columns
+        n_rows = self._rows_const(self._N_LIMBS_CONST, 1)
+        mn = None
+        for j in range(NLIMBS):
+            prod = m * n_rows[j][None, :]  # (n, B), shifted to cols j..j+n-1
+            parts = [] if j == 0 else [jnp.zeros((j, B), cols.dtype)]
+            parts += [prod, jnp.zeros((NLIMBS - j, B), cols.dtype)]
+            step = jnp.concatenate(parts, axis=0)
+            mn = step if mn is None else mn + step
+        total = cols + mn
+        tb = []
+        for k in range(NCOLS):
+            terms = min(k + 1, NCOLS - 1 - k, NLIMBS)
+            l = bounds[k][0] - terms * mmax * MASK
+            h = bounds[k][1] + terms * mmax * MASK
+            assert -(2**31) < l and h < 2**31, "T+mN column overflow"
+            tb.append((l, h))
+
+        # exact low ripple: value(total[:n]) ≡ 0 (mod R); fold its carry
+        # out into column n.  n unrolled (1, B) shift-adds; the remainder
+        # limbs are exactly zero by construction and are dropped.
+        cin = jnp.zeros((1, B), cols.dtype)
+        cin_lo = cin_hi = 0
+        for i in range(NLIMBS):
+            s_lo, s_hi = tb[i][0] + cin_lo, tb[i][1] + cin_hi
+            assert -(2**31) < s_lo and s_hi < 2**31, "ripple overflow"
+            cin = (total[i : i + 1] + cin) >> BITS
+            cin_lo, cin_hi = s_lo >> BITS, s_hi >> BITS
+
+        t = total[NLIMBS:]
+        t = jnp.concatenate([t[:1] + cin, t[1:]], axis=0)
+        t_bounds = [
+            (tb[NLIMBS][0] + cin_lo, tb[NLIMBS][1] + cin_hi)
+        ] + tb[NLIMBS + 1 :]
+        # value(t) = (T + m·N)/R  — the Montgomery contraction
+        out_val_lo = (val_lo - m_val_max * self.P_INT) // self.R_INT - 1
+        out_val_hi = (val_hi + m_val_max * self.P_INT) // self.R_INT + 1
+        out = F(
+            t,
+            min(l for l, _ in t_bounds[:-1]),
+            max(h for _, h in t_bounds[:-1]),
+            t_bounds[-1][0],
+            t_bounds[-1][1],
+            out_val_lo,
+            out_val_hi,
+        )
+        return self.carry(out)
+
+    def mul(self, a: F, b: F) -> F:
+        """Montgomery product REDC(a·b) — the ring multiply."""
+        if a is b:
+            return self.square(a)
+        while self.NLIMBS * a.absmax * b.absmax >= self._I32_LIMIT:
+            a, b = (
+                (self.carry(a), b) if a.absmax >= b.absmax
+                else (a, self.carry(b))
+            )
+        cols = self._cols_skew(a.v, b.v)
+        vals = [
+            a.val_lo * b.val_lo, a.val_lo * b.val_hi,
+            a.val_hi * b.val_lo, a.val_hi * b.val_hi,
+        ]
+        return self._redc(
+            cols, self._prod_col_bounds(a.absmax, b.absmax),
+            min(vals), max(vals),
+        )
+
+    def square(self, a: F) -> F:
+        while self.NLIMBS * a.absmax * a.absmax >= self._I32_LIMIT:
+            a = self.carry(a)
+        vals = [a.val_lo * a.val_lo, a.val_lo * a.val_hi, a.val_hi * a.val_hi]
+        return self._redc(
+            self._cols_sq(a.v), self._prod_col_bounds(a.absmax, a.absmax),
+            min(vals), max(vals),
+        )

@@ -231,3 +231,27 @@ def verify_batch(
         kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
     )
     return (accept & structural)[:n]
+
+
+def verify_batches_overlapped(
+    work: "Sequence[tuple[Sequence[bytes], Sequence[bytes], Sequence[bytes]]]",
+) -> list:
+    """Verify several (pubs, msgs, sigs) batches with host/device overlap:
+    each batch is DISPATCHED before the previous result is fetched, so the
+    host prep (SHA-512 + packing) of batch i+1 runs while the device
+    ladders batch i, and on backends that queue dispatches the kernels
+    pipeline (VERDICT r4 #3 — amortizing the per-dispatch floor across
+    consecutive commits; through the axon tunnel dispatches do not
+    pipeline, so the overlap is host-side only and the honest per-commit
+    floor remains in bench.py's ``dispatch_floor_ms``).
+
+    Returns a list of (n,) bool arrays, one per input batch."""
+    kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
+    inflight = []  # (device result, n, structural)
+    for pubs, msgs, sigs in work:
+        arrays, n, structural = prepare_batch(pubs, msgs, sigs)
+        dev = kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
+        inflight.append((dev, n, structural))  # no block: async dispatch
+    return [
+        (np.asarray(dev) & structural)[:n] for dev, n, structural in inflight
+    ]

@@ -1,0 +1,263 @@
+"""Batched short-Weierstrass (a = 0) curve arithmetic over an
+``ops.fpgen.Field`` — the curve layer shared by BLS12-381 G1 (b = 4) and
+secp256k1 (b = 7).
+
+Points are PROJECTIVE (X : Y : Z) batches of Montgomery limbs, one point
+per TPU lane, with the COMPLETE addition formulas of
+Renes–Costello–Batina 2015 (algorithm 7 specialization for a = 0): one
+branch-free formula valid for every input pair — doubling, mixed signs,
+and the identity (0 : 1 : 0) included.  No exceptional-case selects, no
+field equality tests, no per-lane flags — exactly what a SIMD lane needs
+(the Jacobian formulas host oracles use have exceptional cases that would
+each cost a canonical field comparison here).
+
+``ops.bls_g1`` binds this to the P381 field (including the MSM used by
+RLC BLS batch verification); ``ops.secp_verify`` binds it to the
+secp256k1 field for batched ECDSA (BASELINE config #4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from cometbft_tpu.ops.fpgen import F, Field
+
+
+class Point(NamedTuple):
+    x: F
+    y: F
+    z: F
+
+
+jax.tree_util.register_pytree_node(
+    Point, lambda p: ((p.x, p.y, p.z), None), lambda aux, ch: Point(*ch)
+)
+
+
+class Curve:
+    """All point ops bound to one (field, b3 = 3·b) configuration."""
+
+    def __init__(self, field: Field, b3: int):
+        # The fixed hulls below assume the Montgomery contraction regime
+        # R/P >= 2^9: REDC then shrinks value bounds faster than the
+        # formula adds/mul_smalls grow them, and the canonical top limb
+        # stays within ±64.  Pick nlimbs accordingly when binding a field.
+        assert field.R_INT >= field.P_INT << 9, (
+            "field needs >= 9 bits of Montgomery headroom (add a limb)"
+        )
+        self.fp = field
+        self.B3 = b3
+        # Fixed static-bounds signature for loop-carried coordinates:
+        # limbs at the carry fixpoint (±1 slack), top limb and value
+        # within generous hulls every formula output re-enters after one
+        # carry (asserted in _fix).
+        self._LIMB_HULL = (field.RED_LO - 2, field.RED_HI + 2)
+        self._TOP_HULL = (-64, 64)
+        self._VAL_HULL = (-32 * field.P_INT, 32 * field.P_INT)
+
+    def _fix(self, a: F) -> F:
+        """Carry and clamp to the canonical static-bounds signature, so
+        loop-carried pytrees have identical aux data every iteration."""
+        fp = self.fp
+        a = fp.carry(a)
+        assert self._LIMB_HULL[0] <= a.lo and a.hi <= self._LIMB_HULL[1], (
+            a.lo, a.hi,
+        )
+        assert (
+            self._TOP_HULL[0] <= a.top_lo and a.top_hi <= self._TOP_HULL[1]
+        ), (a.top_lo, a.top_hi)
+        assert (
+            self._VAL_HULL[0] <= a.val_lo and a.val_hi <= self._VAL_HULL[1]
+        ), (a.val_lo, a.val_hi)
+        return F(a.v, *self._LIMB_HULL, *self._TOP_HULL, *self._VAL_HULL)
+
+    def fix_point(self, p: Point) -> Point:
+        return Point(self._fix(p.x), self._fix(p.y), self._fix(p.z))
+
+    def add(self, p: Point, q: Point) -> Point:
+        """Complete projective addition (RCB15 alg. 7, a=0)."""
+        fp = self.fp
+        x1, y1, z1 = p.x, p.y, p.z
+        x2, y2, z2 = q.x, q.y, q.z
+        t0 = fp.mul(x1, x2)
+        t1 = fp.mul(y1, y2)
+        t2 = fp.mul(z1, z2)
+        t3 = fp.mul(fp.add(x1, y1), fp.add(x2, y2))
+        t3 = fp.sub(t3, fp.add(t0, t1))  # X1Y2 + X2Y1
+        t4 = fp.mul(fp.add(y1, z1), fp.add(y2, z2))
+        t4 = fp.sub(t4, fp.add(t1, t2))  # Y1Z2 + Y2Z1
+        xz = fp.mul(fp.add(x1, z1), fp.add(x2, z2))
+        xz = fp.sub(xz, fp.add(t0, t2))  # X1Z2 + X2Z1
+        return self._tail(t0, t1, t2, t3, t4, xz)
+
+    def double(self, p: Point) -> Point:
+        """The same complete formula with squarings where operands
+        coincide."""
+        fp = self.fp
+        x1, y1, z1 = p.x, p.y, p.z
+        t0 = fp.square(x1)
+        t1 = fp.square(y1)
+        t2 = fp.square(z1)
+        t3 = fp.sub(fp.square(fp.add(x1, y1)), fp.add(t0, t1))  # 2XY
+        t4 = fp.sub(fp.square(fp.add(y1, z1)), fp.add(t1, t2))  # 2YZ
+        xz = fp.sub(fp.square(fp.add(x1, z1)), fp.add(t0, t2))  # 2XZ
+        return self._tail(t0, t1, t2, t3, t4, xz)
+
+    def _tail(self, t0, t1, t2, t3, t4, xz) -> Point:
+        """Shared tail of the complete a=0 formula."""
+        fp = self.fp
+        s0 = fp.add(fp.add(t0, t0), t0)  # 3·X1X2
+        t2 = fp.mul_small(t2, self.B3)
+        z3 = fp.add(t1, t2)
+        t1 = fp.sub(t1, t2)
+        y3 = fp.mul_small(xz, self.B3)
+        x3 = fp.sub(fp.mul(t3, t1), fp.mul(t4, y3))
+        y3m = fp.add(fp.mul(t1, z3), fp.mul(y3, s0))
+        z3m = fp.add(fp.mul(z3, t4), fp.mul(s0, t3))
+        return Point(x3, y3m, z3m)
+
+    def identity(self, batch: int) -> Point:
+        """(0 : 1 : 0), exact limbs."""
+        fp = self.fp
+        return Point(
+            fp.pack([0] * batch), fp.pack([1] * batch), fp.pack([0] * batch)
+        )
+
+    def select(self, bit: jnp.ndarray, a: Point, b: Point) -> Point:
+        """Per-lane select (bit: (B,) int/bool): a where bit else b.
+        Operands must share the fixed bounds signature (fix_point)."""
+
+        def sel(u: F, v: F) -> F:
+            assert (u.lo, u.hi, u.top_lo, u.top_hi, u.val_lo, u.val_hi) == (
+                v.lo, v.hi, v.top_lo, v.top_hi, v.val_lo, v.val_hi,
+            ), "select operands must be fixed first"
+            return F(
+                jnp.where(bit[None, :] != 0, u.v, v.v),
+                u.lo, u.hi, u.top_lo, u.top_hi, u.val_lo, u.val_hi,
+            )
+
+        return Point(sel(a.x, b.x), sel(a.y, b.y), sel(a.z, b.z))
+
+    def scalar_mul(self, base: Point, bits: jnp.ndarray) -> Point:
+        """Per-lane double-and-add, MSB first.  ``bits``: (nbits, B) int32
+        of 0/1.  Branch-free: the add always runs; the bit selects."""
+        base = self.fix_point(base)
+        nbits = bits.shape[0]
+        acc0 = self.fix_point(self.identity(bits.shape[1]))
+
+        def body(i, acc):
+            acc = self.fix_point(self.double(acc))
+            added = self.fix_point(self.add(acc, base))
+            bit = jax.lax.dynamic_slice_in_dim(bits, i, 1, axis=0)[0]
+            return self.select(bit, added, acc)
+
+        return jax.lax.fori_loop(0, nbits, body, acc0)
+
+    def double_scalar_mul(
+        self, p: Point, q: Point, pbits: jnp.ndarray, qbits: jnp.ndarray
+    ) -> Point:
+        """Per-lane u·P + v·Q in ONE Straus/Shamir ladder: per bit
+        position the addend is selected among {O, P, Q, P+Q} and the add
+        always runs (the complete formula absorbs O).  Cost equals a
+        single scalar_mul ladder — the ECDSA shape u1·G + u2·Q."""
+        fp = self.fp
+        assert pbits.shape == qbits.shape
+        p = self.fix_point(p)
+        q = self.fix_point(q)
+        pq = self.fix_point(self.add(p, q))
+        nbits = pbits.shape[0]
+        batch = pbits.shape[1]
+        acc0 = self.fix_point(self.identity(batch))
+        ident = acc0
+
+        def body(i, acc):
+            acc = self.fix_point(self.double(acc))
+            pb = jax.lax.dynamic_slice_in_dim(pbits, i, 1, axis=0)[0]
+            qb = jax.lax.dynamic_slice_in_dim(qbits, i, 1, axis=0)[0]
+            addend = self.select(pb & qb, pq, ident)
+            addend = self.select(pb & (1 - qb), p, addend)
+            addend = self.select((1 - pb) & qb, q, addend)
+            return self.fix_point(self.add(acc, addend))
+
+        return jax.lax.fori_loop(0, nbits, body, acc0)
+
+    def lane_sum(self, p: Point) -> Point:
+        """Fold the lane axis down to ONE point by pairwise complete adds
+        — log2(B) adds over halving widths.  Lanes must be padded to a
+        power of two with identity points by the caller."""
+        width = p.x.v.shape[1]
+        assert width & (width - 1) == 0, "lane_sum needs a power-of-two batch"
+        while width > 1:
+            half = width // 2
+
+            def halves(f: F):
+                return (
+                    F(f.v[:, :half], *f[1:]),
+                    F(f.v[:, half:], *f[1:]),
+                )
+
+            ax, bx = halves(p.x)
+            ay, by = halves(p.y)
+            az, bz = halves(p.z)
+            p = self.fix_point(
+                self.add(Point(ax, ay, az), Point(bx, by, bz))
+            )
+            width = half
+        return p
+
+    # -- host packing / unpacking -----------------------------------------
+
+    def pack_points(
+        self, points: Sequence[Optional[tuple]], batch: int | None = None
+    ) -> Point:
+        """Affine (x, y) int pairs (None = infinity) -> projective batch,
+        padded with identity to ``batch`` (rounded up to a power of
+        two)."""
+        fp = self.fp
+        n = len(points)
+        if batch is not None and batch < n:
+            raise ValueError(
+                f"batch {batch} would silently drop {n - batch} trailing points"
+            )
+        b = batch if batch is not None else n
+        b = 1 << max(b - 1, 0).bit_length() if b > 1 else 1  # next pow2
+        xs, ys, zs = [], [], []
+        for i in range(b):
+            pt = points[i] if i < n else None
+            if pt is None:
+                xs.append(0)
+                ys.append(1)
+                zs.append(0)
+            else:
+                xs.append(pt[0])
+                ys.append(pt[1])
+                zs.append(1)
+        return Point(fp.pack(xs), fp.pack(ys), fp.pack(zs))
+
+    def unpack_points(self, p: Point) -> list:
+        """Projective batch -> affine (x, y) pairs / None (host bigints)."""
+        fp = self.fp
+        xs, ys, zs = fp.unpack(p.x), fp.unpack(p.y), fp.unpack(p.z)
+        out = []
+        for x, y, z in zip(xs, ys, zs):
+            if z == 0:
+                out.append(None)
+            else:
+                zi = pow(z, -1, fp.P_INT)
+                out.append(((x * zi) % fp.P_INT, (y * zi) % fp.P_INT))
+        return out
+
+
+def pack_scalar_bits(scalars: Sequence[int], nbits: int, batch: int) -> np.ndarray:
+    """(nbits, batch) int32 bit rows, MSB first; lanes past the scalar
+    list get 0 (×identity lanes from pack_points are harmless anyway)."""
+    out = np.zeros((nbits, batch), np.int32)
+    for j, s in enumerate(scalars):
+        assert 0 <= s < (1 << nbits), "scalar exceeds nbits"
+        for i in range(nbits):
+            out[nbits - 1 - i, j] = (s >> i) & 1
+    return out

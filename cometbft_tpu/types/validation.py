@@ -122,8 +122,13 @@ def _verify_commit(
         use_batch = _should_batch(vals, commit) and len(entries) >= 2
         if use_batch:
             bv = cbatch.create_batch_verifier(entries[0][1].pub_key, backend)
-            for idx, val, cs in entries:
-                bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            # one native call builds every sign-bytes (10k-commit hot
+            # path); python per-index fallback inside
+            sign_bytes = commit.all_vote_sign_bytes(
+                chain_id, [idx for idx, _, _ in entries]
+            )
+            for (idx, val, cs), sb in zip(entries, sign_bytes):
+                bv.add(val.pub_key, sb, cs.signature)
             ok, bits = bv.verify()
             if not ok:
                 for (idx, _, _), bit in zip(entries, bits):

@@ -19,5 +19,12 @@ for SAN in $SANS; do
       cometbft_native.cpp native_stress.cpp -o "${out}" -lpthread
   echo "== run (${SAN}) =="
   "${out}" "/tmp/native_stress_${SAN}.wal"
+
+  blsout="/tmp/bls_stress_${SAN}"
+  echo "== build bls -fsanitize=${SAN} =="
+  g++ -O1 -g -std=c++17 -fsanitize="${SAN}" -fno-omit-frame-pointer \
+      bls12381.cpp bls_stress.cpp -o "${blsout}" -lpthread
+  echo "== run bls (${SAN}) =="
+  "${blsout}"
 done
 echo "sanitize_native: ALL CLEAN"

@@ -179,3 +179,27 @@ def test_verify_batch_mixed_and_edges():
     assert (got == expect).all()
     # sanity on the expectation itself
     assert list(expect) == [False, False, False, False, True, True, True, False, False, True]
+
+
+
+class TestOverlappedBatches:
+    def test_matches_verify_batch(self):
+        from cometbft_tpu.crypto import ed25519_ref as ref
+        from cometbft_tpu.ops import verify as ov
+
+        work = []
+        for b in range(3):
+            pubs, msgs, sigs = [], [], []
+            for i in range(5):
+                seed = bytes([b * 16 + i + 1]) * 32
+                pubs.append(ref.pubkey_from_seed(seed))
+                msgs.append(b"ovl-%d-%d" % (b, i))
+                sigs.append(ref.sign(seed, msgs[-1]))
+            if b == 1:
+                sigs[2] = bytes(64)  # one structurally-bad lane
+            work.append((pubs, msgs, sigs))
+        outs = ov.verify_batches_overlapped(work)
+        assert len(outs) == 3
+        for out, (pubs, msgs, sigs) in zip(outs, work):
+            expect = ov.verify_batch(pubs, msgs, sigs)
+            assert (out == expect).all()

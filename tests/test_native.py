@@ -147,3 +147,61 @@ class TestNativeWAL:
         assert recs[0] == b"payload-000" * 8
         assert recs[-1] == b"payload-099" * 8
         r.close()
+
+
+class TestCommitSignBytes:
+    """The C++ canonical sign-bytes builder must be byte-exact with the
+    python encoder (types/canonical.py) for every flag/timestamp shape."""
+
+    def _commit(self, n=7):
+        from cometbft_tpu.types.basic import (
+            BLOCK_ID_FLAG_COMMIT,
+            BLOCK_ID_FLAG_NIL,
+            BlockID,
+            PartSetHeader,
+            Timestamp,
+        )
+        from cometbft_tpu.types.block import Commit
+        from cometbft_tpu.types.vote import CommitSig
+
+        bid = BlockID(
+            hash=hashlib.sha256(b"csb-block").digest(),
+            part_set_header=PartSetHeader(
+                3, hashlib.sha256(b"csb-parts").digest()
+            ),
+        )
+        sigs = []
+        for i in range(n):
+            flag = BLOCK_ID_FLAG_NIL if i % 3 == 2 else BLOCK_ID_FLAG_COMMIT
+            ts = (
+                Timestamp(0, 0)
+                if i == 4  # zero timestamp -> field omitted entirely
+                else Timestamp(1_700_000_000 + i, 123_456_789 * (i % 2))
+            )
+            sigs.append(
+                CommitSig(
+                    block_id_flag=flag,
+                    validator_address=bytes([i]) * 20,
+                    timestamp=ts,
+                    signature=bytes(64),
+                )
+            )
+        return Commit(height=12345, round_=2, block_id=bid, signatures=sigs)
+
+    def test_differential_all_indices(self, nlib):
+        commit = self._commit()
+        got = commit.all_vote_sign_bytes("csb-chain")
+        want = [
+            commit.vote_sign_bytes("csb-chain", i)
+            for i in range(len(commit.signatures))
+        ]
+        assert got == want
+
+    def test_differential_subset_and_fallback(self, nlib, monkeypatch):
+        commit = self._commit()
+        got = commit.all_vote_sign_bytes("csb-chain", [5, 1, 2])
+        want = [commit.vote_sign_bytes("csb-chain", i) for i in (5, 1, 2)]
+        assert got == want
+        # python fallback path must agree too
+        monkeypatch.setattr(native, "lib", lambda: None)
+        assert commit.all_vote_sign_bytes("csb-chain", [5, 1, 2]) == want

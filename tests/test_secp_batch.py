@@ -1,0 +1,97 @@
+"""Batched secp256k1 ECDSA: differential tests vs the host
+`cryptography` library (BASELINE config #4 — a TPU-era extension; the
+reference verifies secp sequentially, crypto/secp256k1/secp256k1.go)."""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey, Secp256k1PubKey
+from cometbft_tpu.ops import secp_verify as sv
+
+N = sv.N
+
+
+def _fixture(n, seed_tag=b"secp"):
+    privs = [
+        Secp256k1PrivKey.from_secret(seed_tag + b"-%d" % i) for i in range(n)
+    ]
+    pubs = [p.pub_key().bytes() for p in privs]
+    msgs = [b"vote-bytes-%d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    return privs, pubs, msgs, sigs
+
+
+class TestDeviceLadder:
+    def test_mixed_validity_matches_host(self):
+        _, pubs, msgs, sigs = _fixture(6)
+        # corrupt: flipped sig byte, wrong message, wrong pubkey
+        sigs[1] = sigs[1][:-1] + bytes([sigs[1][-1] ^ 1])
+        msgs[3] = b"tampered"
+        pubs[4] = pubs[0]
+        bits = sv.verify_batch(pubs, msgs, sigs)
+        host = [
+            Secp256k1PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)
+        ]
+        assert bits.tolist() == host == [True, False, True, False, False, True]
+
+    def test_structural_rejects(self):
+        _, pubs, msgs, sigs = _fixture(4)
+        sigs[0] = sigs[0][:32] + bytes(32)          # s = 0
+        sigs[1] = bytes(32) + sigs[1][32:]          # r = 0
+        # non-low-S: s -> N - s (valid ECDSA but must be rejected)
+        r, s = sigs[2][:32], int.from_bytes(sigs[2][32:], "big")
+        sigs[2] = r + (N - s).to_bytes(32, "big")
+        pubs[3] = b"\x05" + pubs[3][1:]             # bad SEC1 prefix
+        bits = sv.verify_batch(pubs, msgs, sigs)
+        assert bits.tolist() == [False, False, False, False]
+        host = []
+        for p, m, s_ in zip(pubs, msgs, sigs):
+            try:
+                host.append(Secp256k1PubKey(p).verify_signature(m, s_))
+            except ValueError:
+                host.append(False)
+        assert host == [False, False, False, False]
+
+    def test_decompress_roundtrip(self):
+        _, pubs, _, _ = _fixture(3)
+        for pub in pubs:
+            pt = sv.decompress_pubkey(pub)
+            assert pt is not None
+            x, y = pt
+            assert (y * y - (x**3 + 7)) % sv.P == 0
+            assert x == int.from_bytes(pub[1:], "big")
+            assert (y & 1) == (pub[0] & 1)
+
+    def test_odd_batch_padding(self):
+        _, pubs, msgs, sigs = _fixture(3)
+        bits = sv.verify_batch(pubs, msgs, sigs)
+        assert bits.tolist() == [True, True, True]
+
+
+class TestSeam:
+    def test_batch_verifier_device_path(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_SECP_DEVICE", "1")
+        privs, pubs, msgs, sigs = _fixture(5)
+        sigs[2] = sigs[2][:-1] + bytes([sigs[2][-1] ^ 1])
+        v = cbatch.Secp256k1BatchVerifier()
+        for p, m, s in zip(privs, msgs, sigs):
+            v.add(p.pub_key(), m, s)
+        ok, bits = v.verify()
+        assert not ok
+        assert bits == [True, True, False, True, True]
+
+    def test_batch_verifier_cpu_backend(self):
+        privs, pubs, msgs, sigs = _fixture(3)
+        v = cbatch.Secp256k1BatchVerifier(backend="cpu")
+        for p, m, s in zip(privs, msgs, sigs):
+            v.add(p.pub_key(), m, s)
+        ok, bits = v.verify()
+        assert ok and bits == [True, True, True]
+
+    def test_create_batch_verifier_routes_secp(self):
+        priv = Secp256k1PrivKey.from_secret(b"route")
+        assert cbatch.supports_batch_verifier(priv.pub_key())
+        v = cbatch.create_batch_verifier(priv.pub_key())
+        assert isinstance(v, cbatch.Secp256k1BatchVerifier)
