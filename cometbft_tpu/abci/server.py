@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import socket
+import sys
 import threading
 from typing import Optional
 
@@ -76,6 +77,7 @@ class ABCIServer:
 
     def _handle_conn(self, conn: socket.socket) -> None:
         rfile = conn.makefile("rb")
+        method = "<none>"
         try:
             while self._running:
                 method, req = codec.read_request(rfile)
@@ -85,9 +87,23 @@ class ABCIServer:
                     with self._app_lock:
                         resp = getattr(self.app, method)(req)
                 conn.sendall(codec.encode_response(method, resp))
-        except (EOFError, OSError):
-            pass
+        except (EOFError, OSError) as e:
+            # orderly client disconnect is normal; anything else is worth a
+            # trace on stderr (the app process's log) before dropping the
+            # conn — a silent close here surfaces to the node only as an
+            # opaque "ABCI stream closed"
+            if not isinstance(e, EOFError):
+                print(
+                    f"abci server: conn error after {method}: {e!r}",
+                    file=sys.stderr,
+                    flush=True,
+                )
         except Exception as e:  # app error: report and close (ref kills node)
+            print(
+                f"abci server: app error in {method}: {e!r}",
+                file=sys.stderr,
+                flush=True,
+            )
             try:
                 conn.sendall(codec.encode_error("error", str(e)))
             except OSError:

@@ -13,7 +13,10 @@ surface is flat sections of scalars/lists, which TOML expresses exactly).
 from __future__ import annotations
 
 import os
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields as dc_fields, is_dataclass, asdict
 from typing import Optional
 

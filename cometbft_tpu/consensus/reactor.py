@@ -57,34 +57,9 @@ _GOSSIP_SLEEP = 0.05
 _MAJ23_SLEEP = 2.0
 
 
-def _commit_sigs(commit):
-    """Signature list of a plain or extended commit (``is None`` test, not
-    truthiness: a decoded-empty extended signature list must not fall
-    through to a ``signatures`` attribute ExtendedCommit lacks)."""
-    ext = getattr(commit, "extended_signatures", None)
-    return commit.signatures if ext is None else ext
-
-
-def _commit_vote(commit, idx: int) -> Optional[Vote]:
-    """Reconstruct validator idx's precommit from a stored commit
-    (reference: types/block.go Commit.GetByIndex).  Works for plain and
-    extended commits — extended signatures restore the vote extension,
-    without which peers at extension-enabled heights reject the vote."""
-    cs = _commit_sigs(commit)[idx]
-    if cs.absent():
-        return None
-    return Vote(
-        type_=PRECOMMIT_TYPE,
-        height=commit.height,
-        round_=commit.round_,
-        block_id=cs.block_id(commit.block_id),
-        timestamp=cs.timestamp,
-        validator_address=cs.validator_address,
-        validator_index=idx,
-        signature=cs.signature,
-        extension=getattr(cs, "extension", b""),
-        extension_signature=getattr(cs, "extension_signature", b""),
-    )
+# shared with the deterministic simulator's catchup path (sim/cluster.py)
+from cometbft_tpu.types.block import commit_sigs as _commit_sigs
+from cometbft_tpu.types.block import commit_vote as _commit_vote
 
 
 class PeerState:

@@ -84,7 +84,14 @@ class ConsensusState(BaseService):
         event_bus: Optional[EventBus] = None,
         evidence_pool=None,
         logger: Optional[liblog.Logger] = None,
+        clock: Optional[Callable[[], float]] = None,
+        ticker_factory: Optional[Callable[[Callable], object]] = None,
+        threaded: bool = True,
     ):
+        """``clock``/``ticker_factory``/``threaded`` form the determinism
+        seam (sim/clock.py): a simulation injects a virtual clock and a
+        virtual-time ticker and drives the receive loop synchronously via
+        ``process_pending`` instead of the consumer thread."""
         super().__init__("ConsensusState")
         self.config = config
         self.block_exec = block_exec
@@ -101,7 +108,9 @@ class ConsensusState(BaseService):
 
         self._mtx = libsync.rlock("consensus.state")
         self._queue: "queue.Queue[tuple[str, object]]" = queue.Queue(maxsize=1000)
-        self.ticker = TimeoutTicker(self._tock)
+        self._clock: Callable[[], float] = clock or _time.time
+        self._threaded = threaded
+        self.ticker = (ticker_factory or TimeoutTicker)(self._tock)
         self._thread: Optional[threading.Thread] = None
         self._done_first_height = threading.Event()
 
@@ -135,10 +144,11 @@ class ConsensusState(BaseService):
         self.ticker.start()
         if self.wal is not None:
             self._catchup_replay()
-        self._thread = threading.Thread(
-            target=self._receive_routine, name="cs-receive", daemon=True
-        )
-        self._thread.start()
+        if self._threaded:
+            self._thread = threading.Thread(
+                target=self._receive_routine, name="cs-receive", daemon=True
+            )
+            self._thread.start()
         # kick off round 0 for the current height
         self._schedule_round0()
 
@@ -199,44 +209,69 @@ class ConsensusState(BaseService):
                 continue
             if kind == "quit":
                 return
-            try:
-                if kind == "peer":
-                    mi: MsgInfo = payload
-                    if self.wal is not None:
-                        try:
-                            self.wal.write(cmsg.encode_msg(mi.msg))
-                        except TypeError:
-                            pass
-                    self._handle_msg(mi)
-                elif kind == "internal":
-                    mi = payload
-                    if self.wal is not None:
-                        try:
-                            self.wal.write_sync(cmsg.encode_msg(mi.msg))
-                        except TypeError:
-                            pass
-                    self._handle_msg(mi)
-                elif kind == "timeout":
-                    ti: TimeoutInfo = payload
-                    if self.wal is not None:
-                        self.wal.write_sync(
-                            cmsg.encode_timeout_wal(
-                                ti.duration, ti.height, ti.round_, ti.step
-                            )
-                        )
-                    self._handle_timeout(ti)
-                elif kind == "txs":
-                    self._handle_txs_available()
-            except Exception as e:  # noqa: BLE001 — consensus must not die silently
-                self.logger.error(
-                    "consensus failure", err=repr(e), height=self.rs.height
-                )
-                import traceback
+            self._process_one(kind, payload)
 
-                traceback.print_exc()
+    def process_pending(self) -> int:
+        """Drain queued inputs synchronously; returns how many were handled.
+
+        Only for ``threaded=False`` instances (the deterministic simulation
+        drives each node's receive loop from the virtual-time scheduler).
+        """
+        n = 0
+        while True:
+            try:
+                kind, payload = self._queue.get_nowait()
+            except queue.Empty:
+                return n
+            if kind == "quit":
+                return n
+            self._process_one(kind, payload)
+            n += 1
+
+    def _process_one(self, kind: str, payload: object) -> None:
+        try:
+            if kind == "peer":
+                mi: MsgInfo = payload
+                if self.wal is not None:
+                    try:
+                        self.wal.write(cmsg.encode_msg(mi.msg))
+                    except TypeError:
+                        pass
+                self._handle_msg(mi)
+            elif kind == "internal":
+                mi = payload
+                if self.wal is not None:
+                    try:
+                        self.wal.write_sync(cmsg.encode_msg(mi.msg))
+                    except TypeError:
+                        pass
+                self._handle_msg(mi)
+            elif kind == "timeout":
+                ti: TimeoutInfo = payload
+                if self.wal is not None:
+                    self.wal.write_sync(
+                        cmsg.encode_timeout_wal(
+                            ti.duration, ti.height, ti.round_, ti.step
+                        )
+                    )
+                self._handle_timeout(ti)
+            elif kind == "txs":
+                self._handle_txs_available()
+        except Exception as e:  # noqa: BLE001 — consensus must not die silently
+            self.logger.error(
+                "consensus failure", err=repr(e), height=self.rs.height
+            )
+            import traceback
+
+            traceback.print_exc()
 
     def _tock(self, ti: TimeoutInfo) -> None:
         self._queue.put(("timeout", ti))
+
+    def _now_ts(self) -> Timestamp:
+        """Vote/proposal timestamps come from the injected clock so a
+        simulated node's signatures are a pure function of virtual time."""
+        return Timestamp.from_ns(int(self._clock() * 1e9))
 
     # ------------------------------------------------------------------
     # message handling (reference :886 handleMsg)
@@ -323,7 +358,7 @@ class ConsensusState(BaseService):
     def _schedule_round0(self) -> None:
         """Wait until start_time then enter round 0 (reference:
         scheduleRound0, state.go:1950)."""
-        sleep = max(self.rs.start_time - _time.time(), 0.0)
+        sleep = max(self.rs.start_time - self._clock(), 0.0)
         self.ticker.schedule_timeout(
             TimeoutInfo(sleep, self.rs.height, 0, STEP_NEW_HEIGHT)
         )
@@ -439,6 +474,7 @@ class ConsensusState(BaseService):
                     last_commit,
                     self._priv_addr,
                     last_ext_commit_info=ext_info,
+                    block_time=self._now_ts(),
                 )
             except Exception as e:  # noqa: BLE001
                 self.logger.error("failed to create proposal block", err=repr(e))
@@ -451,7 +487,7 @@ class ConsensusState(BaseService):
             round_=round_,
             pol_round=rs.valid_round,
             block_id=block_id,
-            timestamp=Timestamp.now(),
+            timestamp=self._now_ts(),
         )
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
@@ -686,7 +722,7 @@ class ConsensusState(BaseService):
         self.logger.debug("enter commit", height=height, round=commit_round)
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
-        rs.commit_time = _time.time()
+        rs.commit_time = self._clock()
         self._new_step()
 
         block_id = rs.votes.precommits(commit_round).two_thirds_majority()
@@ -788,9 +824,9 @@ class ConsensusState(BaseService):
         if rs.commit_time > 0:
             start = rs.commit_time + self.config.commit_timeout()
         else:
-            start = _time.time() + self.config.commit_timeout()
+            start = self._clock() + self.config.commit_timeout()
         if self.config.skip_timeout_commit and last_precommits is not None:
-            start = _time.time()
+            start = self._clock()
 
         self.state = state
         rs.height = height
@@ -836,7 +872,7 @@ class ConsensusState(BaseService):
         ):
             raise VoteError("invalid proposal signature")
         rs.proposal = proposal
-        rs.proposal_receive_time = _time.time()
+        rs.proposal_receive_time = self._clock()
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.part_set_header)
             self._drain_orphan_parts()
@@ -1125,7 +1161,7 @@ class ConsensusState(BaseService):
             height=rs.height,
             round_=rs.round_,
             block_id=block_id,
-            timestamp=Timestamp.now(),
+            timestamp=self._now_ts(),
             validator_address=self._priv_addr,
             validator_index=idx,
         )

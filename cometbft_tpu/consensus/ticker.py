@@ -4,6 +4,13 @@ A single timer keyed on (height, round, step): scheduling a new timeout for a
 later (H,R,S) replaces the pending one; stale fires (for an earlier H,R,S than
 the last scheduled) are dropped.  Fired timeouts are delivered to a callback
 that enqueues them into the consensus receive loop.
+
+This module is a seam: ``ConsensusState`` accepts any ``ticker_factory``
+producing an object with ``schedule_timeout(TimeoutInfo)`` / ``start()`` /
+``stop()`` and the one-pending-timeout replacement semantics above.
+``TimeoutTicker`` is the wall-clock implementation (threading.Timer);
+``sim/clock.py``'s ``SimTicker`` is the virtual-time one used by the
+deterministic simulation harness.
 """
 
 from __future__ import annotations

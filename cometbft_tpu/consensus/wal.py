@@ -142,6 +142,19 @@ class WAL:
         except (OSError, ValueError):
             pass
 
+    def kill(self) -> None:
+        """Simulate abrupt process death (kill -9): only bytes the kernel
+        already has survive; any user-space buffered tail is lost.  The head
+        file is truncated back to its pre-close on-disk size so the graceful
+        close below cannot quietly flush data a real crash would have
+        dropped.  fsync'd records (internal messages, #ENDHEIGHT) were
+        written through before this point and are never cut; a mid-frame
+        tail is handled by the tolerant (strict=False) replay readers."""
+        size = os.path.getsize(self.path) if os.path.exists(self.path) else 0
+        self.close()
+        if os.path.exists(self.path) and os.path.getsize(self.path) > size:
+            os.truncate(self.path, size)
+
     # -- reading / replay -------------------------------------------------
 
     def _files(self) -> list[str]:
@@ -189,6 +202,42 @@ class WAL:
                             raise WALCorruptionError("crc mismatch")
                         return
                     yield WALRecord(kind=body[0], payload=body[1:])
+
+    def scan_end_heights(self, start: int = 0) -> tuple[set, int]:
+        """Incrementally collect #ENDHEIGHT markers from the HEAD file,
+        parsing only bytes past ``start``; returns (heights, next_offset).
+
+        ``next_offset`` stops before any incomplete or corrupt trailing
+        frame (tolerant tail semantics), so a caller polling a live WAL
+        resumes there once more bytes land.  Head-file only — rolled files
+        are static history a caller has already seen or can read once via
+        ``iter_records``.  This keeps a per-event checker (sim/invariants)
+        O(new bytes) instead of re-parsing the whole log per height.
+        """
+        if self._f is not None:
+            self._f.flush()
+        heights: set = set()
+        if not os.path.exists(self.path):
+            return heights, 0
+        with open(self.path, "rb") as f:
+            f.seek(start)
+            offset = start
+            while True:
+                hdr = f.read(8)
+                if len(hdr) < 8:
+                    break
+                crc, length = struct.unpack(">II", hdr)
+                if length > MAX_MSG_SIZE + 1:
+                    break
+                body = f.read(length)
+                if len(body) < length:
+                    break
+                if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    break
+                if body[0] == _REC_END_HEIGHT:
+                    heights.add(int.from_bytes(body[1:], "big"))
+                offset += 8 + length
+        return heights, offset
 
     def search_for_end_height(self, height: int) -> bool:
         """True if an #ENDHEIGHT marker for `height` exists

@@ -337,7 +337,17 @@ class BlsBatchVerifier(_CollectingVerifier):
                 bits[i] = True
             return all(bits), bits
         # attribution fallback: the combination failed, find the culprits
-        for i, _, _, _ in entries:
+        return self._per_signature([e[0] for e in entries], bits)
+
+    def _per_signature(self, entries, bits) -> tuple[bool, list[bool]]:
+        """Verify each structurally-valid entry on its own.  This is the
+        refuge when a native batch op errors: such an error is an
+        infrastructure failure, not evidence against any signature, so it
+        must not surface as all-False bits (which would misattribute the
+        failure to every signer in the batch)."""
+        from cometbft_tpu.crypto import bls12381 as bls
+
+        for i in entries:
             bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
         return all(bits), bits
 
@@ -345,7 +355,8 @@ class BlsBatchVerifier(_CollectingVerifier):
         """RLC batch verification with every host-side group/pairing op in
         the native library; the TPU G1 MSM still handles the rᵢ·pkᵢ
         multi-scalar-mul when the device passes its self-check.  Same
-        check and attribution semantics as the pure-Python path."""
+        check and attribution semantics as the pure-Python path.  Any
+        native-op *error* (nonzero return) drops to ``_per_signature``."""
         import ctypes
         import secrets
 
@@ -383,7 +394,7 @@ class BlsBatchVerifier(_CollectingVerifier):
             for i, rb in zip(entries, r_bytes):
                 out = ctypes.create_string_buffer(96)
                 if lib.bls_g1_scalar_mul(self.pubs[i], rb, 16, out) != 0:
-                    return False, bits
+                    return self._per_signature(entries, bits)
                 g1_parts.append(bls.g1_negate_serialized(out.raw))
 
         # Σ rᵢ·Sᵢ and H(mᵢ), all native
@@ -392,18 +403,18 @@ class BlsBatchVerifier(_CollectingVerifier):
         for i, rb in zip(entries, r_bytes):
             so = ctypes.create_string_buffer(96)
             if lib.bls_g2_scalar_mul_compressed(self.sigs[i], rb, 16, so) != 0:
-                return False, bits
+                return self._per_signature(entries, bits)
             scaled_sigs.append(so.raw)
             ho = ctypes.create_string_buffer(96)
             msg = self.msgs[i]
             if lib.bls_hash_to_g2(msg, len(msg), ho) != 0:
-                return False, bits
+                return self._per_signature(entries, bits)
             hashes.append(ho.raw)
         agg = ctypes.create_string_buffer(96)
         if lib.bls_aggregate_sigs(
             b"".join(scaled_sigs), len(scaled_sigs), agg
         ) != 0:
-            return False, bits
+            return self._per_signature(entries, bits)
 
         from cometbft_tpu.crypto.bls12381 import G1_GEN, g1_serialize
 
@@ -416,9 +427,7 @@ class BlsBatchVerifier(_CollectingVerifier):
                 bits[i] = True
             return all(bits), bits
         # attribution fallback: the combination failed, find the culprits
-        for i in entries:
-            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
-        return all(bits), bits
+        return self._per_signature(entries, bits)
 
     @staticmethod
     def _scaled_pubkeys(pks, rs, backend: Optional[str] = None):

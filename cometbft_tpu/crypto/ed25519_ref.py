@@ -22,6 +22,9 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading as _threading
+from collections import OrderedDict
+from functools import lru_cache as _lru_cache
 from typing import Optional, Tuple
 
 # ---------------------------------------------------------------------------
@@ -104,6 +107,46 @@ def pt_mul(k: int, p1: Point) -> Point:
     return acc
 
 
+# ---------------------------------------------------------------------------
+# Comb-table scalar multiplication
+#
+# A comb table for point P holds, for every 4-bit window i of the scalar,
+# the multiples [j * 16^i * P for j in 1..15].  A scalar-mul is then just
+# one table lookup + point addition per non-zero nibble (<= 64 additions,
+# no doublings), ~8x cheaper than the double-and-add ladder.  Tables are
+# built once per point: at import for the base point, LRU-cached per
+# public key for verification — validators verify the same handful of
+# keys thousands of times, which is the hot path this exists for.
+# ---------------------------------------------------------------------------
+
+_COMB_WINDOWS = 64  # 64 x 4-bit nibbles covers any scalar < 2^256
+
+
+def _build_comb(p1: Point) -> tuple:
+    rows = []
+    base = p1
+    for _ in range(_COMB_WINDOWS):
+        row = [None, base]
+        for j in range(2, 16):
+            row.append(pt_add(row[j - 1], base))
+        rows.append(tuple(row))
+        for _ in range(4):
+            base = pt_double(base)
+    return tuple(rows)
+
+
+def _comb_mul(comb: tuple, k: int) -> Point:
+    acc = IDENTITY
+    i = 0
+    while k > 0:
+        nib = k & 15
+        if nib:
+            acc = pt_add(acc, comb[i][nib])
+        k >>= 4
+        i += 1
+    return acc
+
+
 def pt_equal(p1: Point, p2: Point) -> bool:
     """Projective equality: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
     X1, Y1, Z1, _ = p1
@@ -123,6 +166,13 @@ assert _ok
 if _bx & 1:  # RFC 8032: base point has x with sign bit 0
     _bx = P - _bx
 BASE: Point = (_bx, _by, 1, _bx * _by % P)
+
+_BASE_COMB = _build_comb(BASE)
+
+
+def pt_mul_base(k: int) -> Point:
+    """k*B through the precomputed base-point comb (sign / verify hot path)."""
+    return _comb_mul(_BASE_COMB, k)
 
 
 # ---------------------------------------------------------------------------
@@ -178,31 +228,90 @@ def _expand_seed(seed: bytes) -> Tuple[int, bytes]:
 
 def pubkey_from_seed(seed: bytes) -> bytes:
     a, _ = _expand_seed(seed)
-    return pt_compress(pt_mul(a, BASE))
+    return pt_compress(pt_mul_base(a))
 
 
 def generate_seed() -> bytes:
     return os.urandom(32)
 
 
+@_lru_cache(maxsize=64)
+def _expanded_with_pub(seed: bytes) -> Tuple[int, bytes, bytes]:
+    """(a, prefix, compressed pub) for a seed — one comb-mul, cached so a
+    validator signing thousands of votes derives its pubkey once."""
+    a, prefix = _expand_seed(seed)
+    return a, prefix, pt_compress(pt_mul_base(a))
+
+
 def sign(seed: bytes, msg: bytes) -> bytes:
     """RFC 8032 Ed25519 signing."""
-    a, prefix = _expand_seed(seed)
-    pub = pt_compress(pt_mul(a, BASE))
+    a, prefix, pub = _expanded_with_pub(seed)
     r = sc_reduce(hashlib.sha512(prefix + msg).digest())
-    R = pt_compress(pt_mul(r, BASE))
+    R = pt_compress(pt_mul_base(r))
     k = sc_reduce(hashlib.sha512(R + pub + msg).digest())
     s = (r + k * a) % L
     return R + s.to_bytes(32, "little")
+
+
+# Comb tables per compressed public key, built on SECOND sight: a comb
+# build costs ~3 ladder muls, so a one-shot key (fuzzed garbage, an
+# ephemeral peer) sticks to the plain ladder while any repeated key — a
+# validator verifying thousands of votes — gets the ~8x comb.  Keyed on
+# the encoding, not the point: ZIP-215 accepts non-canonical encodings,
+# and two encodings of one point simply build equal tables.  Failed
+# decompressions are never cached, so garbage cannot evict real keys.
+_PUB_COMB_CACHE: "OrderedDict[bytes, tuple]" = OrderedDict()
+_PUB_COMB_MAX = 256
+_PUB_SEEN: "OrderedDict[bytes, int]" = OrderedDict()
+_PUB_SEEN_MAX = 1024
+# verify_zip215 runs concurrently on reactor/consensus/p2p threads; LRU
+# bookkeeping (get + move_to_end vs evicting insert) must be atomic or a
+# hit can race an eviction into a KeyError out of signature verification
+_COMB_LOCK = _threading.Lock()
+
+
+def _comb_caches_clear() -> None:
+    with _COMB_LOCK:
+        _PUB_COMB_CACHE.clear()
+        _PUB_SEEN.clear()
+
+
+def _pub_comb(pub: bytes) -> Optional[tuple]:
+    """Comb table for a compressed public key, or None (first sight or
+    decompress failure) — the caller falls back to the ladder."""
+    with _COMB_LOCK:
+        comb = _PUB_COMB_CACHE.get(pub)
+        if comb is not None:
+            _PUB_COMB_CACHE.move_to_end(pub)
+            return comb
+        seen = _PUB_SEEN.get(pub, 0) + 1
+        if seen < 2:
+            _PUB_SEEN[pub] = seen
+            _PUB_SEEN.move_to_end(pub)
+            if len(_PUB_SEEN) > _PUB_SEEN_MAX:
+                _PUB_SEEN.popitem(last=False)
+            return None
+    A = pt_decompress_zip215(pub)
+    if A is None:
+        return None
+    comb = _build_comb(A)  # outside the lock: ~1100 point ops
+    with _COMB_LOCK:
+        _PUB_SEEN.pop(pub, None)
+        _PUB_COMB_CACHE[pub] = comb
+        if len(_PUB_COMB_CACHE) > _PUB_COMB_MAX:
+            _PUB_COMB_CACHE.popitem(last=False)
+    return comb
 
 
 def verify_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """Cofactored single verification with ZIP-215 semantics."""
     if len(sig) != 64 or len(pub) != 32:
         return False
-    A = pt_decompress_zip215(pub)
-    if A is None:
-        return False
+    comb_a = _pub_comb(pub)
+    if comb_a is None:
+        A = pt_decompress_zip215(pub)
+        if A is None:
+            return False
     R = pt_decompress_zip215(sig[:32])
     if R is None:
         return False
@@ -210,8 +319,9 @@ def verify_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
     if s >= L:  # s must be canonical
         return False
     h = sc_reduce(hashlib.sha512(sig[:32] + pub + msg).digest())
+    hA = _comb_mul(comb_a, h) if comb_a is not None else pt_mul(h, A)
     # Q = s*B - h*A - R ; accept iff [8]Q == identity.
-    Q = pt_add(pt_add(pt_mul(s, BASE), pt_neg(pt_mul(h, A))), pt_neg(R))
+    Q = pt_add(pt_add(pt_mul_base(s), pt_neg(hA)), pt_neg(R))
     for _ in range(3):
         Q = pt_double(Q)
     return pt_is_identity(Q)

@@ -15,8 +15,12 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ed25519 as _lib_ed25519
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _lib_ed25519
+except ImportError:  # no C-speed verifier: pure-Python ZIP-215 only
+    InvalidSignature = None
+    _lib_ed25519 = None
 
 from cometbft_tpu.crypto import ed25519_ref, tmhash
 
@@ -47,6 +51,8 @@ class Ed25519PubKey:
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != 64:
             return False
+        if _lib_ed25519 is None:
+            return ed25519_ref.verify_zip215(self.data, msg, sig)
         try:
             _lib_ed25519.Ed25519PublicKey.from_public_bytes(self.data).verify(
                 sig, msg

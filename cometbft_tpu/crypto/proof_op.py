@@ -193,6 +193,8 @@ class ProofOperators(list):
         self.verify(root, keypath, [value])
 
     def verify(self, root: bytes, keypath: str, args: Sequence[bytes]) -> None:
+        if len(self) == 0:
+            raise ProofError("no proof operators")
         keys = key_path_to_keys(keypath)
         for i, op in enumerate(self):
             key = op.get_key()
@@ -208,6 +210,8 @@ class ProofOperators(list):
                     )
                 keys.pop()
             args = op.run(args)
+        if not args:
+            raise ProofError("proof operators produced no root")
         if args[0] != root:
             raise ProofError(f"computed root {args[0].hex()}, want {root.hex()}")
         if keys:
@@ -240,8 +244,12 @@ class ProofRuntime:
     def verify_absence(
         self, proof: ProofOps, root: bytes, keypath: str
     ) -> None:
-        """Verify a proof of non-existence (empty args; proof_op.go:137)."""
-        self.decode_proof(proof).verify(root, keypath, [b""])
+        """Verify a proof of non-existence (empty args; proof_op.go:137).
+
+        The arg list must be EMPTY, not ``[b""]``: an existence proof of an
+        empty stored value verifies against ``[b""]``, which would let it
+        masquerade as an absence proof (inverted safety semantics)."""
+        self.decode_proof(proof).verify(root, keypath, [])
 
 
 def default_proof_runtime() -> ProofRuntime:

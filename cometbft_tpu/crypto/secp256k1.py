@@ -10,20 +10,97 @@ has no BatchVerifier, crypto/batch falls back to sequential).
 from __future__ import annotations
 
 import hashlib
+import hmac as _hmac_mod
+import os as _os
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+except ImportError:  # no C library: pure-Python affine ECDSA below
+    ec = None
 
 SECP256K1_KEY_TYPE = "secp256k1"
 
 # curve order (for low-S normalization)
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+# field prime and generator for the pure-Python fallback
+_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+_G = (
+    0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+    0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+)
+
+
+def _pt_add(p1, p2):
+    """Affine point addition (None is the identity)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % _P == 0:
+            return None
+        lam = (3 * x1 * x1) * pow(2 * y1, -1, _P) % _P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, _P) % _P
+    x3 = (lam * lam - x1 - x2) % _P
+    return (x3, (lam * (x1 - x3) - y1) % _P)
+
+
+def _pt_mul(k: int, p):
+    acc = None
+    while k:
+        if k & 1:
+            acc = _pt_add(acc, p)
+        p = _pt_add(p, p)
+        k >>= 1
+    return acc
+
+
+def _pt_compress(p) -> bytes:
+    x, y = p
+    return bytes([2 | (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _pt_decompress(pub33: bytes):
+    if len(pub33) != 33 or pub33[0] not in (2, 3):
+        return None
+    x = int.from_bytes(pub33[1:], "big")
+    if x >= _P:
+        return None
+    y2 = (pow(x, 3, _P) + 7) % _P
+    y = pow(y2, (_P + 1) // 4, _P)  # p ≡ 3 (mod 4)
+    if y * y % _P != y2:
+        return None
+    if (y & 1) != (pub33[0] & 1):
+        y = _P - y
+    return (x, y)
+
+
+def _rfc6979_k(secret: bytes, digest: bytes) -> int:
+    """Deterministic nonce (RFC 6979, SHA-256) — no RNG to misuse."""
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = _hmac_mod.new(k, v + b"\x00" + secret + digest, hashlib.sha256).digest()
+    v = _hmac_mod.new(k, v, hashlib.sha256).digest()
+    k = _hmac_mod.new(k, v + b"\x01" + secret + digest, hashlib.sha256).digest()
+    v = _hmac_mod.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = _hmac_mod.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < _N:
+            return cand
+        k = _hmac_mod.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = _hmac_mod.new(k, v, hashlib.sha256).digest()
 
 
 def _address(pub33: bytes) -> bytes:
@@ -57,6 +134,8 @@ class Secp256k1PubKey:
         s = int.from_bytes(sig[32:], "big")
         if r == 0 or s == 0 or s > _N // 2:  # reject non-low-S (reference)
             return False
+        if ec is None:
+            return self._verify_pure(msg, r, s)
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 ec.SECP256K1(), self.data
@@ -67,6 +146,19 @@ class Secp256k1PubKey:
             return True
         except (InvalidSignature, ValueError):
             return False
+
+    def _verify_pure(self, msg: bytes, r: int, s: int) -> bool:
+        if r >= _N or s >= _N:
+            return False
+        q = _pt_decompress(self.data)
+        if q is None:
+            return False
+        e = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+        w = pow(s, -1, _N)
+        rp = _pt_add(
+            _pt_mul(e * w % _N, _G), _pt_mul(r * w % _N, q)
+        )
+        return rp is not None and rp[0] % _N == r
 
     def bytes(self) -> bytes:
         return self.data
@@ -80,6 +172,11 @@ class Secp256k1PrivKey:
 
     @staticmethod
     def generate() -> "Secp256k1PrivKey":
+        if ec is None:
+            while True:
+                raw = _os.urandom(32)
+                if 0 < int.from_bytes(raw, "big") < _N:
+                    return Secp256k1PrivKey(raw)
         key = ec.generate_private_key(ec.SECP256K1())
         raw = key.private_numbers().private_value.to_bytes(32, "big")
         return Secp256k1PrivKey(raw)
@@ -94,6 +191,9 @@ class Secp256k1PrivKey:
         )
 
     def pub_key(self) -> Secp256k1PubKey:
+        if ec is None:
+            point = _pt_mul(int.from_bytes(self.secret, "big"), _G)
+            return Secp256k1PubKey(_pt_compress(point))
         from cryptography.hazmat.primitives.serialization import (
             Encoding,
             PublicFormat,
@@ -105,8 +205,25 @@ class Secp256k1PrivKey:
         return Secp256k1PubKey(pub)
 
     def sign(self, msg: bytes) -> bytes:
+        if ec is None:
+            return self._sign_pure(msg)
         der = self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
         r, s = decode_dss_signature(der)
+        if s > _N // 2:
+            s = _N - s  # low-S normalization
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def _sign_pure(self, msg: bytes) -> bytes:
+        d = int.from_bytes(self.secret, "big")
+        digest = hashlib.sha256(msg).digest()
+        e = int.from_bytes(digest, "big")
+        k = _rfc6979_k(self.secret, digest)
+        while True:
+            r = _pt_mul(k, _G)[0] % _N
+            s = pow(k, -1, _N) * (e + r * d) % _N
+            if r != 0 and s != 0:
+                break
+            k = (k + 1) % _N or 1  # astronomically unlikely; stay total
         if s > _N // 2:
             s = _N - s  # low-S normalization
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")

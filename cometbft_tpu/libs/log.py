@@ -65,7 +65,13 @@ class Logger:
         for k, v in {**self.bound, **kv}.items():
             parts.append(f"{k}={_render(v)}")
         with _write_lock:
-            print(" ".join(parts), file=self.out)
+            try:
+                print(" ".join(parts), file=self.out)
+            except ValueError:
+                # daemon threads may log during interpreter shutdown after
+                # the sink (pytest capture, a closed pipe) is gone; dropping
+                # the line beats a traceback storm on teardown
+                pass
 
     def debug(self, msg: str, **kv: Any) -> None:
         self._log(DEBUG, msg, kv)

@@ -28,12 +28,20 @@ import socket as _socket
 import struct
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature, InvalidTag
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # no C library: pure-Python RFC 7748/8439 fallback
+    from cometbft_tpu.crypto.aead_ref import (
+        ChaCha20Poly1305Ref as ChaCha20Poly1305,
+        InvalidTagRef as InvalidTag,
+        X25519PrivateKeyRef as X25519PrivateKey,
+        X25519PublicKeyRef as X25519PublicKey,
+    )
 
 from cometbft_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey
 from cometbft_tpu.libs import protoenc as pe

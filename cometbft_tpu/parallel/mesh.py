@@ -24,7 +24,20 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6 keeps it in experimental, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+        )
 
 from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import verify as ov

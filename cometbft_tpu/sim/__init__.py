@@ -1,0 +1,32 @@
+"""Deterministic simulation & fault-injection harness.
+
+FoundationDB-style discipline: an N-validator cluster runs entirely in one
+thread on *virtual* time.  Every source of scheduling nondeterminism — link
+delays, drops, duplicates, reordering, partitions, crashes, consensus
+timeouts — flows through one seeded ``random.Random`` and one event heap
+(``VirtualClock``), so a failing run reproduces byte-identically from its
+seed.  Invariant checkers (agreement / validity / WAL replay) run after
+every delivered event.
+
+Entry points:
+  * ``SimCluster``   — assemble and drive a cluster programmatically
+  * ``run_scenario`` — named fault scripts (``cometbft-tpu sim`` CLI)
+"""
+
+from cometbft_tpu.sim.clock import SimTicker, VirtualClock
+from cometbft_tpu.sim.cluster import SimCluster
+from cometbft_tpu.sim.invariants import InvariantChecker, InvariantViolation
+from cometbft_tpu.sim.network import LinkConfig, SimNetwork
+from cometbft_tpu.sim.scenarios import SCENARIOS, run_scenario
+
+__all__ = [
+    "SCENARIOS",
+    "InvariantChecker",
+    "InvariantViolation",
+    "LinkConfig",
+    "SimCluster",
+    "SimNetwork",
+    "SimTicker",
+    "VirtualClock",
+    "run_scenario",
+]

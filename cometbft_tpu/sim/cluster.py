@@ -1,0 +1,290 @@
+"""Single-threaded N-validator cluster on virtual time.
+
+The drive loop is strictly sequential: pop the next virtual-clock event
+(message delivery, consensus timeout, scripted fault, catchup tick), let it
+enqueue work, then drain every node's receive queue in index order until the
+cluster is quiescent, then run the incremental invariant checkers.  No other
+thread exists, so the full event trace — and therefore every commit hash and
+every failure — is a pure function of (seed, scenario script).
+
+Catchup: push gossip alone cannot rescue a node that missed a commit (its
+peers have moved to later heights whose votes it ignores), so the cluster
+runs a virtual-time catchup tick modelled on the reactor's
+``gossipDataForCatchup``: a lagging node is served the seen-commit votes and
+block parts for its current height by the lowest-indexed connected peer that
+has them, through the same faulty fabric as everything else.
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+from typing import Optional
+
+from cometbft_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from cometbft_tpu.types.block import commit_vote as _commit_vote
+from cometbft_tpu.sim.clock import SimTicker, VirtualClock
+from cometbft_tpu.sim.invariants import InvariantChecker
+from cometbft_tpu.sim.network import SimNetwork
+from cometbft_tpu.sim.node import (
+    NodeHandle,
+    build_node,
+    make_genesis,
+    sim_consensus_config,
+)
+from cometbft_tpu.state.state import state_from_genesis
+
+SIM_CHAIN_ID = "sim-chain"
+CATCHUP_INTERVAL = 0.5  # virtual seconds between catchup scans
+
+
+def describe_msg(msg) -> str:
+    """Deterministic one-line rendering for the event trace; includes a
+    signature prefix so the trace is sensitive to byte-level divergence."""
+    if isinstance(msg, ProposalMessage):
+        p = msg.proposal
+        return "Proposal h=%d r=%d blk=%s sig=%s" % (
+            p.height,
+            p.round_,
+            p.block_id.hash.hex()[:12],
+            p.signature.hex()[:12],
+        )
+    if isinstance(msg, BlockPartMessage):
+        return "BlockPart h=%d r=%d i=%d" % (msg.height, msg.round_, msg.part.index)
+    if isinstance(msg, VoteMessage):
+        v = msg.vote
+        return "Vote t=%d h=%d r=%d v%d blk=%s sig=%s" % (
+            v.type_,
+            v.height,
+            v.round_,
+            v.validator_index,
+            v.block_id.hash.hex()[:12],
+            v.signature.hex()[:12],
+        )
+    return type(msg).__name__
+
+
+class SimCluster:
+    def __init__(
+        self,
+        n_vals: int,
+        root,
+        seed: int = 0,
+        config=None,
+        raise_on_violation: bool = True,
+        check_wal: bool = True,
+        catchup: bool = True,
+    ):
+        self.n_vals = n_vals
+        self.root = Path(root)
+        self.seed = seed
+        self.config = config or sim_consensus_config()
+        self.raise_on_violation = raise_on_violation
+        self.clock = VirtualClock()
+        self.rng = random.Random(seed)
+        self.privs, self.gdoc = make_genesis(n_vals, SIM_CHAIN_ID)
+        self.net = SimNetwork(self.clock, self.rng, n_vals)
+        self.net.deliver_fn = self._on_deliver
+        self.net.alive_fn = lambda i: self.nodes[i] is not None
+        self.checker = InvariantChecker(
+            SIM_CHAIN_ID, state_from_genesis(self.gdoc).validators, check_wal
+        )
+        self.trace: list[str] = []
+        self.events_fired = 0
+        self._dbs: list = [None] * n_vals  # MemKV survives crash-restart
+        self.nodes: list[Optional[NodeHandle]] = [
+            self._build(i) for i in range(n_vals)
+        ]
+        self._started = False
+        self._catchup = catchup
+
+    # -- assembly ----------------------------------------------------------
+
+    def _build(self, i: int) -> NodeHandle:
+        node = build_node(
+            i,
+            self.privs[i],
+            self.gdoc,
+            self.root,
+            config=self.config,
+            db=self._dbs[i],
+            clock=self.clock,
+            ticker_factory=lambda tock, i=i: SimTicker(
+                self.clock, tock, name=f"node{i}"
+            ),
+            threaded=False,
+        )
+        self._dbs[i] = node.block_store._db
+        node.cs.broadcast_hook = lambda msg, i=i: self.net.send(i, msg)
+        return node
+
+    def live_nodes(self) -> list[NodeHandle]:
+        return [n for n in self.nodes if n is not None]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for node in self.live_nodes():
+            self._log("start node%d" % node.index)
+            node.cs.start()
+        if self._catchup:
+            self.clock.call_later(
+                CATCHUP_INTERVAL, self._catchup_tick, label="catchup"
+            )
+        self._drain_all()
+
+    def stop(self) -> None:
+        for node in self.live_nodes():
+            node.cs.stop()
+            node.app_conns.stop()
+
+    def crash(self, i: int) -> None:
+        """Kill node i: its process state vanishes, its stores/WAL/privval
+        files survive for ``restart``.  In-flight traffic to it is dropped,
+        and the WAL loses its unflushed user-space tail (``WAL.kill``) —
+        a graceful stop would fsync it and hide lost-tail replay bugs."""
+        node = self.nodes[i]
+        if node is None:
+            return
+        self._log("crash node%d" % i)
+        self.nodes[i] = None  # alive_fn now reports dead
+        if node.cs.wal is not None:
+            node.cs.wal.kill()
+        node.cs.stop()
+        node.app_conns.stop()
+
+    def restart(self, i: int) -> None:
+        """Rebuild node i from its persisted stores: Handshaker replays the
+        app, WAL catchup replays unfinished-height consensus inputs."""
+        if self.nodes[i] is not None:
+            return
+        self._log("restart node%d" % i)
+        node = self._build(i)
+        self.nodes[i] = node
+        node.cs.start()
+        self._drain_all()
+        self.checker.on_restart(self, i)
+
+    # -- event loop --------------------------------------------------------
+
+    def _on_deliver(self, dst: int, src: int, msg) -> None:
+        node = self.nodes[dst]
+        if node is None or not node.cs.is_running:
+            return
+        self._log("deliver %d->%d %s" % (src, dst, describe_msg(msg)))
+        node.cs.add_peer_message(msg, peer_id=f"node{src}")
+
+    def _drain_all(self) -> None:
+        progress = True
+        while progress:
+            progress = False
+            for node in self.nodes:
+                if node is not None and node.cs.is_running:
+                    if node.cs.process_pending():
+                        progress = True
+
+    def step(self) -> bool:
+        """Fire one scheduled event + drain + check invariants."""
+        timer = self.clock.tick()
+        if timer is None:
+            return False
+        self.events_fired += 1
+        if (
+            timer.label
+            and not timer.label.startswith("net ")
+            and timer.label != "catchup"
+        ):
+            # deliveries log themselves with message detail; catchup ticks
+            # are pure scheduling noise
+            self._log("fire %s" % timer.label)
+        self._drain_all()
+        self.trace.extend(self.checker.on_event(self))
+        return True
+
+    def run(
+        self,
+        until_height: Optional[int] = None,
+        max_time: float = 600.0,
+        max_events: int = 500_000,
+    ) -> bool:
+        """Drive until every live node has committed ``until_height`` (or
+        the virtual-time/event budget runs out).  Returns success."""
+        self.start()
+        while True:
+            if until_height is not None and self.reached(until_height):
+                return True
+            if self.clock.now() >= max_time or self.events_fired >= max_events:
+                return until_height is not None and self.reached(until_height)
+            if not self.step():
+                return until_height is not None and self.reached(until_height)
+
+    def reached(self, height: int) -> bool:
+        """Every validator — crashed ones count as behind — has committed
+        ``height``; 'the cluster made it' means no node left behind."""
+        return all(
+            n is not None and n.block_store.height() >= height
+            for n in self.nodes
+        )
+
+    def heights(self) -> list[int]:
+        return [
+            -1 if n is None else n.block_store.height() for n in self.nodes
+        ]
+
+    def commit_hash(self, height: int) -> Optional[bytes]:
+        for node in self.live_nodes():
+            meta = node.block_store.load_block_meta(height)
+            if meta is not None:
+                return meta.block_id.hash
+        return None
+
+    # -- catchup -----------------------------------------------------------
+
+    def _catchup_tick(self) -> None:
+        for node in self.live_nodes():
+            want = node.cs.rs.height  # first height it has not committed
+            helper = next(
+                (
+                    peer
+                    for peer in self.live_nodes()
+                    if peer.index != node.index
+                    and peer.block_store.height() >= want
+                    and self.net.connected(peer.index, node.index)
+                ),
+                None,
+            )
+            if helper is None:
+                continue
+            commit = helper.block_store.load_seen_commit(want)
+            meta = helper.block_store.load_block_meta(want)
+            if commit is None or meta is None:
+                continue
+            for idx in range(len(commit.signatures)):
+                vote = _commit_vote(commit, idx)
+                if vote is not None:
+                    self.net.unicast(
+                        helper.index, node.index, VoteMessage(vote)
+                    )
+            for pi in range(meta.block_id.part_set_header.total):
+                part = helper.block_store.load_block_part(want, pi)
+                if part is not None:
+                    self.net.unicast(
+                        helper.index,
+                        node.index,
+                        BlockPartMessage(
+                            height=want, round_=commit.round_, part=part
+                        ),
+                    )
+        self.clock.call_later(CATCHUP_INTERVAL, self._catchup_tick, label="catchup")
+
+    # -- trace -------------------------------------------------------------
+
+    def _log(self, line: str) -> None:
+        self.trace.append("%.6f %s" % (self.clock.now(), line))

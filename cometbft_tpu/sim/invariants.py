@@ -1,0 +1,174 @@
+"""Safety invariants checked after every simulated event.
+
+Checks are incremental — each (node, height) pair is verified exactly once
+when the node first stores that height — so running them after every
+delivered message costs O(new commits), not O(history):
+
+  * **agreement** — no two nodes ever commit different blocks at one height
+    (the first committed hash per height is the canonical one).
+  * **validity** — every stored seen-commit carries +2/3 valid signatures
+    from the genesis validator set, checked through the production
+    ``verify_commit`` path (and therefore the BatchVerifier seam).
+  * **wal-replay** — the fsync'd ``#ENDHEIGHT h`` marker exists for every
+    height the node committed, so a crash after this point replays
+    deterministically; on restart the rebuilt state must agree with the
+    stores it was rebuilt from.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from cometbft_tpu.types.validation import CommitVerificationError, verify_commit
+
+
+class InvariantViolation(AssertionError):
+    """Raised (or recorded) when a safety property breaks."""
+
+    def __init__(self, name: str, detail: str, time: float = 0.0):
+        super().__init__(f"[{name}] at t={time:.6f}: {detail}")
+        self.invariant = name
+        self.detail = detail
+        self.time = time
+
+
+@dataclass
+class Violation:
+    invariant: str
+    detail: str
+    time: float
+
+
+class InvariantChecker:
+    def __init__(self, chain_id: str, validators, check_wal: bool = True):
+        self.chain_id = chain_id
+        self.validators = validators  # genesis ValidatorSet (no updates in sim)
+        self.check_wal = check_wal
+        self.canonical: dict[int, bytes] = {}  # height -> first committed hash
+        self._checked: dict[int, int] = {}  # node index -> last verified height
+        # incremental WAL readers: node -> (byte offset, end-heights seen);
+        # keeps the per-event WAL check O(new bytes), not O(log) per height
+        self._wal_tail: dict[int, tuple[int, set]] = {}
+        self.violations: list[Violation] = []
+        self.commits_verified = 0
+
+    # -- driver hooks ------------------------------------------------------
+
+    def on_event(self, cluster) -> list[str]:
+        """Verify every height newly stored since the last call; returns
+        deterministic trace lines for fresh commits."""
+        lines: list[str] = []
+        for node in cluster.live_nodes():
+            i = node.index
+            top = node.block_store.height()
+            for h in range(self._checked.get(i, 0) + 1, top + 1):
+                lines.extend(self._check_height(cluster, node, h))
+            self._checked[i] = max(self._checked.get(i, 0), top)
+        return lines
+
+    def on_restart(self, cluster, index: int) -> None:
+        """WAL/store consistency after a crash-restart rebuild."""
+        node = cluster.nodes[index]
+        state = node.state_store.load()
+        store_h = node.block_store.height()
+        state_h = state.last_block_height if state is not None else -1
+        if state_h != store_h:
+            self._violate(
+                cluster,
+                "wal-replay",
+                f"node{index} restarted with state height {state_h} != "
+                f"block store height {store_h}",
+            )
+        # the consensus state must resume at the next height
+        if node.cs.rs.height != store_h + 1 and store_h > 0:
+            self._violate(
+                cluster,
+                "wal-replay",
+                f"node{index} consensus resumed at {node.cs.rs.height}, "
+                f"store at {store_h}",
+            )
+        # re-verification of already-committed heights must still pass
+        self._checked[index] = 0
+
+    # -- checks ------------------------------------------------------------
+
+    def _check_height(self, cluster, node, h: int) -> list[str]:
+        meta = node.block_store.load_block_meta(h)
+        if meta is None:
+            self._violate(
+                cluster, "agreement", f"node{node.index} height {h} has no meta"
+            )
+            return []
+        block_hash = meta.block_id.hash
+        lines = [
+            "%.6f commit node%d h=%d hash=%s"
+            % (cluster.clock.now(), node.index, h, block_hash.hex()[:16])
+        ]
+
+        canonical = self.canonical.setdefault(h, block_hash)
+        if canonical != block_hash:
+            self._violate(
+                cluster,
+                "agreement",
+                f"fork at height {h}: node{node.index} committed "
+                f"{block_hash.hex()[:16]}, canonical is {canonical.hex()[:16]}",
+            )
+
+        commit = node.block_store.load_seen_commit(h)
+        if commit is None:
+            self._violate(
+                cluster,
+                "validity",
+                f"node{node.index} stored height {h} without a seen commit",
+            )
+        else:
+            try:
+                verify_commit(
+                    self.chain_id,
+                    self.validators,
+                    meta.block_id,
+                    h,
+                    commit,
+                    backend="cpu",
+                )
+                self.commits_verified += 1
+            except Exception as e:  # noqa: BLE001 — any rejection is a violation
+                self._violate(
+                    cluster,
+                    "validity",
+                    f"node{node.index} height {h} commit rejected: {e!r}",
+                )
+
+        if self.check_wal and node.cs.wal is not None:
+            if h not in self._wal_ends(node):
+                self._violate(
+                    cluster,
+                    "wal-replay",
+                    f"node{node.index} committed height {h} without an "
+                    f"#ENDHEIGHT marker in its WAL",
+                )
+        return lines
+
+    def _wal_ends(self, node) -> set:
+        """End-height markers in the node's WAL, read incrementally (only
+        the bytes appended since the previous check are parsed)."""
+        import os as _os
+
+        offset, ends = self._wal_tail.get(node.index, (0, set()))
+        wal = node.cs.wal
+        try:
+            size = _os.path.getsize(wal.path)
+        except OSError:
+            size = 0
+        if size < offset:  # truncated (crash dropped an unflushed tail)
+            offset, ends = 0, set()
+        fresh, offset = wal.scan_end_heights(offset)
+        ends |= fresh
+        self._wal_tail[node.index] = (offset, ends)
+        return ends
+
+    def _violate(self, cluster, name: str, detail: str) -> None:
+        v = Violation(name, detail, cluster.clock.now())
+        self.violations.append(v)
+        if cluster.raise_on_violation:
+            raise InvariantViolation(name, detail, cluster.clock.now())

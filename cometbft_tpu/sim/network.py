@@ -1,0 +1,143 @@
+"""In-memory message fabric with seeded fault injection.
+
+Every consensus message a node broadcasts is scheduled for delivery to each
+connected peer through a per-link ``LinkConfig``: uniform delay in
+``[delay_min, delay_max]``, independent drop / duplicate probabilities, and
+a reorder knob that adds extra jitter to a fraction of messages (enough to
+invert arrival order against the send order).  All randomness comes from the
+single ``random.Random`` the cluster seeds, so the full delivery schedule is
+a pure function of (seed, scenario).
+
+Partitions are scripted as node groups: a message crosses the fabric only if
+sender and receiver are in the same group *both* when it is sent and when it
+would arrive — cutting a link also kills traffic already in flight, like
+yanking a cable.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from cometbft_tpu.sim.clock import VirtualClock
+
+
+@dataclass
+class LinkConfig:
+    delay_min: float = 0.01
+    delay_max: float = 0.05
+    drop_rate: float = 0.0
+    dup_rate: float = 0.0
+    reorder_rate: float = 0.0
+    reorder_jitter: float = 0.25  # extra delay ceiling for reordered msgs
+
+    def update(self, **overrides) -> None:
+        for k, v in overrides.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"LinkConfig has no knob {k!r}")
+            setattr(self, k, v)
+
+
+@dataclass
+class NetStats:
+    sent: int = 0
+    delivered: int = 0
+    dropped_rate: int = 0  # lost to drop_rate
+    dropped_partition: int = 0
+    duplicated: int = 0
+
+
+class SimNetwork:
+    """Fabric between ``n`` nodes; delivery goes through ``deliver_fn(dst,
+    src, msg)`` which the cluster installs."""
+
+    def __init__(self, clock: VirtualClock, rng: random.Random, n: int):
+        self.clock = clock
+        self.rng = rng
+        self.n = n
+        self.links: dict[tuple[int, int], LinkConfig] = {
+            (i, j): LinkConfig()
+            for i in range(n)
+            for j in range(n)
+            if i != j
+        }
+        self._group_of: Optional[dict[int, int]] = None  # node -> group id
+        self.deliver_fn: Optional[Callable[[int, int, object], None]] = None
+        self.alive_fn: Callable[[int], bool] = lambda _i: True
+        self.stats = NetStats()
+
+    # -- topology scripting ------------------------------------------------
+
+    def set_link(self, src: int, dst: int, **overrides) -> None:
+        self.links[(src, dst)].update(**overrides)
+
+    def set_all_links(self, **overrides) -> None:
+        for cfg in self.links.values():
+            cfg.update(**overrides)
+
+    def partition(self, *groups: list[int]) -> None:
+        """Split the cluster into the given groups; nodes not named form one
+        implicit remainder group.  Replaces any existing partition."""
+        group_of: dict[int, int] = {}
+        for gid, group in enumerate(groups):
+            for i in group:
+                group_of[i] = gid
+        for i in range(self.n):
+            group_of.setdefault(i, len(groups))
+        self._group_of = group_of
+
+    def heal(self) -> None:
+        self._group_of = None
+
+    def connected(self, i: int, j: int) -> bool:
+        if self._group_of is None:
+            return True
+        return self._group_of[i] == self._group_of[j]
+
+    # -- traffic -----------------------------------------------------------
+
+    def send(self, src: int, msg: object) -> None:
+        """Broadcast from ``src`` to every other live node (push gossip,
+        mirroring the loopback harness this package grew out of)."""
+        for dst in range(self.n):
+            if dst == src:
+                continue
+            self._schedule(src, dst, msg)
+
+    def unicast(self, src: int, dst: int, msg: object) -> None:
+        """Point-to-point send through the same faulty link (catchup)."""
+        self._schedule(src, dst, msg)
+
+    def _schedule(self, src: int, dst: int, msg: object) -> None:
+        cfg = self.links[(src, dst)]
+        self.stats.sent += 1
+        if not self.connected(src, dst):
+            self.stats.dropped_partition += 1
+            return
+        if cfg.drop_rate > 0.0 and self.rng.random() < cfg.drop_rate:
+            self.stats.dropped_rate += 1
+            return
+        copies = 1
+        if cfg.dup_rate > 0.0 and self.rng.random() < cfg.dup_rate:
+            copies = 2
+            self.stats.duplicated += 1
+        for _ in range(copies):
+            delay = self.rng.uniform(cfg.delay_min, cfg.delay_max)
+            if cfg.reorder_rate > 0.0 and self.rng.random() < cfg.reorder_rate:
+                delay += self.rng.uniform(0.0, cfg.reorder_jitter)
+            self.clock.call_later(
+                delay,
+                lambda s=src, d=dst, m=msg: self._deliver(s, d, m),
+                label=f"net {src}->{dst}",
+            )
+
+    def _deliver(self, src: int, dst: int, msg: object) -> None:
+        if not self.connected(src, dst):
+            self.stats.dropped_partition += 1
+            return
+        if not self.alive_fn(dst) or not self.alive_fn(src):
+            return  # crashed endpoints: traffic dies with the process
+        self.stats.delivered += 1
+        if self.deliver_fn is not None:
+            self.deliver_fn(dst, src, msg)

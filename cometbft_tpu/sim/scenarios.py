@@ -1,0 +1,254 @@
+"""Named fault scripts for the deterministic simulator.
+
+A scenario is a list of ``Action``s — (virtual time, description, callable)
+— applied to a running ``SimCluster``.  Everything an action does flows
+through the cluster's seeded clock and RNG, so ``run_scenario(name, seed)``
+reproduces byte-identically: same event trace, same commit hashes, same
+failure (if any).
+
+Built-ins:
+  * ``baseline``           — clean run, default links
+  * ``partition-minority`` — cut off f nodes, heal, expect full recovery
+  * ``partition-leader``   — cut off the current proposer specifically
+  * ``crash-restart``      — kill f nodes mid-run, restart from their stores
+  * ``asymmetric-loss``    — 30% one-directional loss on node0's egress
+  * ``message-storm``      — duplicates + aggressive reordering on all links
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Callable, Optional
+
+from cometbft_tpu.sim.cluster import SimCluster
+
+
+@dataclass
+class Action:
+    at: float
+    name: str
+    fn: Callable[[SimCluster], None]
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    n_vals: int = 4
+    target_height: int = 5
+    max_time: float = 120.0
+    link_overrides: dict = field(default_factory=dict)
+    actions: Callable[[Scenario], list[Action]] = lambda _s: []
+
+
+@dataclass
+class ScenarioResult:
+    scenario: str
+    seed: int
+    n_vals: int
+    target_height: int
+    reached: bool
+    heights: list[int]
+    virtual_time: float
+    events: int
+    commits_verified: int
+    violations: list[str]
+    trace: list[str]
+    cluster: Optional[SimCluster] = None
+
+    def summary(self) -> dict:
+        """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "n_vals": self.n_vals,
+            "target_height": self.target_height,
+            "reached": self.reached,
+            "heights": self.heights,
+            "virtual_time": round(self.virtual_time, 6),
+            "events": self.events,
+            "commits_verified": self.commits_verified,
+            "invariants_ok": not self.violations,
+            "violations": self.violations,
+        }
+
+
+def _proposer_index(cluster: SimCluster) -> int:
+    """Index of the proposer for the current round in the first live
+    node's view (resolved at action-fire time, not script time)."""
+    node = cluster.live_nodes()[0]
+    addr = node.cs.rs.validators.get_proposer().address
+    for i, priv in enumerate(cluster.privs):
+        if priv.pub_key().address() == addr:
+            return i
+    return 0
+
+
+def _f(n_vals: int) -> int:
+    """Max tolerable faulty nodes for n validators (f < n/3), at least 1."""
+    return max(1, (n_vals - 1) // 3)
+
+
+def _partition_minority(s: Scenario) -> list[Action]:
+    minority = list(range(s.n_vals - _f(s.n_vals), s.n_vals))
+    return [
+        Action(3.0, f"partition minority {minority}",
+               lambda c, m=minority: c.net.partition(m)),
+        Action(25.0, "heal", lambda c: c.net.heal()),
+    ]
+
+
+def _partition_leader(s: Scenario) -> list[Action]:
+    def cut(c: SimCluster) -> None:
+        leader = _proposer_index(c)
+        c._log("scenario: partitioning leader node%d" % leader)
+        c.net.partition([leader])
+
+    return [
+        Action(3.0, "partition current leader", cut),
+        Action(25.0, "heal", lambda c: c.net.heal()),
+    ]
+
+
+def _crash_restart(s: Scenario) -> list[Action]:
+    victims = list(range(1, 1 + _f(s.n_vals)))
+    acts: list[Action] = []
+    for v in victims:
+        acts.append(Action(4.0, f"crash node{v}", lambda c, v=v: c.crash(v)))
+        acts.append(Action(20.0, f"restart node{v}", lambda c, v=v: c.restart(v)))
+    return acts
+
+
+def _asymmetric_loss(s: Scenario) -> list[Action]:
+    def degrade(c: SimCluster) -> None:
+        for dst in range(1, c.n_vals):
+            c.net.set_link(0, dst, drop_rate=0.3)  # egress only; ingress clean
+
+    return [Action(0.0, "30% loss on node0 egress", degrade)]
+
+
+def _message_storm(s: Scenario) -> list[Action]:
+    def inject_txs(c: SimCluster) -> None:
+        h = c.live_nodes()[0].cs.rs.height
+        for node in c.live_nodes():
+            node.mempool.check_tx(b"storm%d=%d" % (h, h))
+
+    acts = [
+        Action(
+            0.0,
+            "storm links: dup 25%, reorder 50%",
+            lambda c: c.net.set_all_links(
+                dup_rate=0.25, reorder_rate=0.5, reorder_jitter=0.5
+            ),
+        )
+    ]
+    acts += [
+        Action(float(t), "inject txs", inject_txs) for t in (2, 5, 8, 11, 14)
+    ]
+    return acts
+
+
+SCENARIOS: dict[str, Scenario] = {
+    s.name: s
+    for s in [
+        Scenario(
+            "baseline",
+            "clean 4-validator run, default delay/jitter links",
+        ),
+        Scenario(
+            "partition-minority",
+            "cut off f nodes for 22 virtual seconds, heal, require full "
+            "recovery with no fork",
+            max_time=180.0,
+            actions=_partition_minority,
+        ),
+        Scenario(
+            "partition-leader",
+            "cut off the current proposer, forcing round changes; heal and "
+            "require it to catch back up",
+            max_time=180.0,
+            actions=_partition_leader,
+        ),
+        Scenario(
+            "crash-restart",
+            "kill f nodes mid-run; restart them from their stores (WAL + "
+            "Handshaker replay) and require rejoin",
+            max_time=180.0,
+            actions=_crash_restart,
+        ),
+        Scenario(
+            "asymmetric-loss",
+            "30% one-directional message loss on node0's outbound links",
+            max_time=240.0,
+            actions=_asymmetric_loss,
+        ),
+        Scenario(
+            "message-storm",
+            "duplicate and aggressively reorder every link while txs flow",
+            max_time=240.0,
+            actions=_message_storm,
+        ),
+    ]
+}
+
+
+def run_scenario(
+    name: str,
+    seed: int,
+    root=None,
+    n_vals: Optional[int] = None,
+    target_height: Optional[int] = None,
+    max_time: Optional[float] = None,
+    raise_on_violation: bool = False,
+    keep_cluster: bool = False,
+) -> ScenarioResult:
+    """Build a cluster, script the scenario's actions onto its virtual
+    clock, and drive it to the target height (or the time budget)."""
+    scenario = SCENARIOS[name]
+    # overrides flow into the scenario the action generators see, so e.g.
+    # _partition_minority picks its victims from the real cluster size
+    scenario = replace(
+        scenario,
+        n_vals=n_vals or scenario.n_vals,
+        target_height=target_height or scenario.target_height,
+        max_time=max_time or scenario.max_time,
+    )
+    created_root = root is None
+    if created_root:
+        root = Path(tempfile.mkdtemp(prefix=f"sim-{name}-{seed}-"))
+    cluster = SimCluster(
+        scenario.n_vals, root, seed=seed, raise_on_violation=raise_on_violation
+    )
+    for src_dst, overrides in scenario.link_overrides.items():
+        cluster.net.set_link(*src_dst, **overrides)
+    for action in scenario.actions(scenario):
+        cluster.clock.call_at(
+            action.at,
+            lambda a=action: a.fn(cluster),
+            label=f"scenario {action.name}",
+        )
+    try:
+        reached = cluster.run(
+            until_height=scenario.target_height, max_time=scenario.max_time
+        )
+    finally:
+        cluster.stop()
+        if created_root and not keep_cluster:
+            shutil.rmtree(root, ignore_errors=True)
+    return ScenarioResult(
+        scenario=name,
+        seed=seed,
+        n_vals=scenario.n_vals,
+        target_height=scenario.target_height,
+        reached=reached,
+        heights=cluster.heights(),
+        virtual_time=cluster.clock.now(),
+        events=cluster.events_fired,
+        commits_verified=cluster.checker.commits_verified,
+        violations=[str(v) for v in cluster.checker.violations],
+        trace=cluster.trace,
+        cluster=cluster if keep_cluster else None,
+    )

@@ -235,6 +235,39 @@ def empty_commit() -> Commit:
     return Commit(height=0, round_=0, block_id=BlockID(), signatures=[])
 
 
+def commit_sigs(commit) -> list:
+    """Signature list of a plain or extended commit (``is None`` test, not
+    truthiness: a decoded-empty extended signature list must not fall
+    through to a ``signatures`` attribute ExtendedCommit lacks)."""
+    ext = getattr(commit, "extended_signatures", None)
+    return commit.signatures if ext is None else ext
+
+
+def commit_vote(commit, idx: int):
+    """Reconstruct validator idx's precommit from a stored commit
+    (reference: types/block.go Commit.GetByIndex).  Works for plain and
+    extended commits — extended signatures restore the vote extension,
+    without which peers at extension-enabled heights reject the vote.
+    Returns None for an absent signature."""
+    from cometbft_tpu.types.vote import Vote
+
+    cs = commit_sigs(commit)[idx]
+    if cs.absent():
+        return None
+    return Vote(
+        type_=PRECOMMIT_TYPE,
+        height=commit.height,
+        round_=commit.round_,
+        block_id=cs.block_id(commit.block_id),
+        timestamp=cs.timestamp,
+        validator_address=cs.validator_address,
+        validator_index=idx,
+        signature=cs.signature,
+        extension=getattr(cs, "extension", b""),
+        extension_signature=getattr(cs, "extension_signature", b""),
+    )
+
+
 @dataclass
 class Block:
     header: Header

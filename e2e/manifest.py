@@ -6,7 +6,10 @@ ABCI flavor, sync modes, per-node perturbations).
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is API-compatible
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 

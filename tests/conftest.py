@@ -24,5 +24,19 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# The env vars above are too late for this process when a sitecustomize has
+# already imported jax (config defaults snapshot the env at import) — pin
+# the cache at the config level too, like the platform.
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soak runs excluded from the tier-1 suite"
+    )

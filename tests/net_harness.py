@@ -1,38 +1,30 @@
 """In-process multi-validator consensus network fixture.
 
 Mirrors the reference's ``internal/consensus/common_test.go`` fixtures: N
-validator nodes, each with its own kvstore app / stores / WAL / FilePV, wired
-over a loopback "switch" that relays every internal message a node generates
-to all other nodes' peer queues (the push equivalent of the reference's
-gossip reactor for in-process testing).
+validator nodes wired over a loopback "switch" that relays every internal
+message a node generates to all other nodes' peer queues (the push
+equivalent of the reference's gossip reactor for in-process testing).
+
+Node assembly lives in ``cometbft_tpu/sim/node.py`` (shared with the
+deterministic simulation harness); this module keeps the wall-clock,
+thread-based wiring the reactor/e2e tests want.
 """
 
 from __future__ import annotations
 
-import hashlib
 import time
-from dataclasses import dataclass, field
 from typing import Optional
 
-from cometbft_tpu.abci.kvstore import KVStoreApplication
-from cometbft_tpu.config.config import ConsensusConfig, MempoolConfig
-from cometbft_tpu.consensus.replay import Handshaker
-from cometbft_tpu.consensus.state import ConsensusState
-from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.config.config import ConsensusConfig
 from cometbft_tpu.crypto.keys import Ed25519PrivKey
-from cometbft_tpu.mempool.clist_mempool import CListMempool
-from cometbft_tpu.privval.file_pv import FilePV
-from cometbft_tpu.proxy.multi_app_conn import AppConns, local_client_creator
-from cometbft_tpu.state.execution import BlockExecutor
-from cometbft_tpu.state.state import state_from_genesis
-from cometbft_tpu.state.store import StateStore
-from cometbft_tpu.store.block_store import BlockStore
-from cometbft_tpu.store.kv import MemKV, SqliteKV
-from cometbft_tpu.types.events import EventBus
-from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
-from cometbft_tpu.types.basic import Timestamp
+from cometbft_tpu.sim.node import NodeHandle, build_node
+from cometbft_tpu.sim.node import make_genesis as _make_genesis
+from cometbft_tpu.types.genesis import GenesisDoc
 
 CHAIN_ID = "test-chain-net"
+
+# the harness node record is the shared assembly's handle
+TestNode = NodeHandle
 
 
 def fast_consensus_config(**overrides) -> ConsensusConfig:
@@ -46,19 +38,6 @@ def fast_consensus_config(**overrides) -> ConsensusConfig:
     for k, v in overrides.items():
         setattr(cfg, k, v)
     return cfg
-
-
-@dataclass
-class TestNode:
-    index: int
-    cs: ConsensusState
-    app: KVStoreApplication
-    app_conns: AppConns
-    mempool: CListMempool
-    block_store: BlockStore
-    state_store: StateStore
-    event_bus: EventBus
-    priv_val: FilePV
 
 
 class LoopbackNet:
@@ -99,17 +78,8 @@ class LoopbackNet:
         raise TimeoutError(f"heights {heights} after {timeout}s, wanted {height}")
 
 
-def make_genesis(n_vals: int):
-    privs = [
-        Ed25519PrivKey.from_seed(hashlib.sha256(b"netval%d" % i).digest())
-        for i in range(n_vals)
-    ]
-    gdoc = GenesisDoc(
-        chain_id=CHAIN_ID,
-        genesis_time=Timestamp(0, 0),
-        validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
-    )
-    return privs, gdoc
+def make_genesis(n_vals: int) -> tuple[list[Ed25519PrivKey], GenesisDoc]:
+    return _make_genesis(n_vals, CHAIN_ID)
 
 
 def make_node(
@@ -120,68 +90,13 @@ def make_node(
     config: Optional[ConsensusConfig] = None,
     db=None,
 ) -> TestNode:
-    config = config or fast_consensus_config()
-    home = tmp_path / f"node{index}"
-    home.mkdir(parents=True, exist_ok=True)
-    db = db if db is not None else MemKV()
-    block_store = BlockStore(db)
-    state_store = StateStore(db)
-
-    app = KVStoreApplication()
-    conns = AppConns(local_client_creator(app))
-    conns.start()
-
-    state = state_store.load()
-    if state is None:
-        state = state_from_genesis(gdoc)
-
-    event_bus = EventBus()
-    handshaker = Handshaker(state_store, block_store, gdoc, event_bus=event_bus)
-    state = handshaker.handshake(state, conns)
-
-    info = conns.query.info()
-    mempool = CListMempool(
-        MempoolConfig(recheck=False),
-        conns.mempool,
-        height=state.last_block_height,
-        lane_priorities=dict(info.lane_priorities),
-        default_lane=info.default_lane,
-    )
-    block_exec = BlockExecutor(
-        state_store,
-        block_store,
-        conns.consensus,
-        mempool,
-        event_bus=event_bus,
-    )
-    pv = FilePV.load_or_generate(
-        str(home / "pv_key.json"), str(home / "pv_state.json")
-    )
-    # overwrite with deterministic key
-    pv = FilePV(priv, str(home / "pv_key.json"), str(home / "pv_state.json"))
-    pv.save()
-
-    wal = WAL(str(home / "cs.wal"))
-    cs = ConsensusState(
-        config,
-        state,
-        block_exec,
-        block_store,
-        mempool,
-        priv_validator=pv,
-        wal=wal,
-        event_bus=event_bus,
-    )
-    return TestNode(
-        index=index,
-        cs=cs,
-        app=app,
-        app_conns=conns,
-        mempool=mempool,
-        block_store=block_store,
-        state_store=state_store,
-        event_bus=event_bus,
-        priv_val=pv,
+    return build_node(
+        index,
+        priv,
+        gdoc,
+        tmp_path,
+        config=config or fast_consensus_config(),
+        db=db,
     )
 
 

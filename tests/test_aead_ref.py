@@ -1,0 +1,103 @@
+"""RFC vectors and differential checks for the pure-Python X25519 /
+ChaCha20-Poly1305 fallback (crypto/aead_ref.py), which backs the
+SecretConnection when the `cryptography` C library is absent."""
+
+import os
+
+import pytest
+
+from cometbft_tpu.crypto import aead_ref as A
+
+
+class TestX25519:
+    def test_rfc7748_vector_1(self):
+        k = bytes.fromhex(
+            "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+        )
+        u = bytes.fromhex(
+            "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+        )
+        want = bytes.fromhex(
+            "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+        )
+        assert A.x25519(k, u) == want
+
+    def test_rfc7748_vector_2(self):
+        k = bytes.fromhex(
+            "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d"
+        )
+        u = bytes.fromhex(
+            "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493"
+        )
+        want = bytes.fromhex(
+            "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957"
+        )
+        assert A.x25519(k, u) == want
+
+    def test_dh_agreement(self):
+        alice = A.X25519PrivateKeyRef.generate()
+        bob = A.X25519PrivateKeyRef.generate()
+        s1 = alice.exchange(bob.public_key())
+        s2 = bob.exchange(alice.public_key())
+        assert s1 == s2 and len(s1) == 32
+
+    def test_differential_vs_c_library(self):
+        x25519lib = pytest.importorskip(
+            "cryptography.hazmat.primitives.asymmetric.x25519"
+        )
+        for i in range(4):
+            raw = os.urandom(32)
+            lib_priv = x25519lib.X25519PrivateKey.from_private_bytes(raw)
+            ours = A.X25519PrivateKeyRef(raw)
+            assert (
+                ours.public_key().public_bytes_raw()
+                == lib_priv.public_key().public_bytes_raw()
+            )
+
+
+class TestChaCha20Poly1305:
+    KEY = bytes(range(0x80, 0xA0))
+    NONCE = bytes.fromhex("070000004041424344454647")
+    AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    PT = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+
+    def test_rfc8439_aead_vector(self):
+        ct = A.ChaCha20Poly1305Ref(self.KEY).encrypt(
+            self.NONCE, self.PT, self.AAD
+        )
+        assert ct[:16].hex() == "d31a8d34648e60db7b86afbc53ef7ec2"
+        assert ct[-16:].hex() == "1ae10b594f09e26a7e902ecbd0600691"
+        assert (
+            A.ChaCha20Poly1305Ref(self.KEY).decrypt(self.NONCE, ct, self.AAD)
+            == self.PT
+        )
+
+    def test_tamper_detected(self):
+        aead = A.ChaCha20Poly1305Ref(self.KEY)
+        ct = bytearray(aead.encrypt(self.NONCE, self.PT, self.AAD))
+        ct[3] ^= 0x01
+        with pytest.raises(A.InvalidTagRef):
+            aead.decrypt(self.NONCE, bytes(ct), self.AAD)
+        with pytest.raises(A.InvalidTagRef):
+            aead.decrypt(self.NONCE, b"short", self.AAD)
+
+    def test_numpy_keystream_matches_scalar(self):
+        for size in (1, 63, 64, 65, 1024, 4097):
+            key, nonce, data = os.urandom(32), os.urandom(12), os.urandom(size)
+            assert A._chacha20_xor_np(
+                key, 3, nonce, data
+            ) == A._chacha20_xor_scalar(key, 3, nonce, data)
+
+    def test_differential_vs_c_library(self):
+        aeadlib = pytest.importorskip(
+            "cryptography.hazmat.primitives.ciphers.aead"
+        )
+        for size in (0, 1, 100, 2048):
+            key, nonce = os.urandom(32), os.urandom(12)
+            data, aad = os.urandom(size), os.urandom(17)
+            assert A.ChaCha20Poly1305Ref(key).encrypt(
+                nonce, data, aad
+            ) == aeadlib.ChaCha20Poly1305(key).encrypt(nonce, data, aad)

@@ -42,6 +42,7 @@ class TestManifest:
 
 
 class TestProcessE2E:
+    @pytest.mark.slow  # multi-process testnet: minutes on a loaded 2-core host
     def test_statesync_late_joiner(self, tmp_path):
         """A fresh full node joins at height 7 via state sync: snapshot
         discovery over p2p, trust hash fetched from the live network's
@@ -78,6 +79,7 @@ class TestProcessE2E:
         finally:
             net.stop()
 
+    @pytest.mark.slow  # load-sensitive: app + 2 nodes + pytest on 2 cores
     def test_socket_abci_node(self, tmp_path):
         """One validator runs its kvstore app as a SEPARATE process over
         the socket ABCI flavor (reference: e2e abci_protocol=socket)."""
@@ -101,6 +103,7 @@ class TestProcessE2E:
         finally:
             net.stop()
 
+    @pytest.mark.slow  # multi-process testnet + load generation
     def test_kill_restart_pipeline(self, tmp_path):
         """3 validators as processes; kill -9 one, restart, verify chain
         invariants + loadtime report."""

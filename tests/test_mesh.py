@@ -21,6 +21,7 @@ from cometbft_tpu.parallel import mesh as pmesh
 
 
 class TestMeshVerify:
+    @pytest.mark.slow  # ~60s of XLA compile on a 2-core CPU host
     def test_dryrun_multichip_8(self):
         # The exact function the driver invokes, on the full 8-device mesh.
         graft.dryrun_multichip(8)
@@ -116,11 +117,17 @@ class TestMeshPallasComposition:
                 jnp.zeros((n,), jnp.int32),
             ]
             lowered = fn.lower(*args)
-            assert "psum" in lowered.as_text()
+            # the collective's spelling depends on the partitioner (shardy
+            # lowers to all-reduce where older pipelines kept psum)
+            text = lowered.as_text()
+            assert any(
+                op in text for op in ("psum", "all-reduce", "all_reduce")
+            ), f"no cross-device collective in lowered text:\n{text[:2000]}"
         finally:
             pv._build.cache_clear()
             pmesh._FN_CACHE.clear()
 
+    @pytest.mark.slow  # pallas interpret mode: ~90s of pure emulation
     def test_sharded_pallas_interpret(self, monkeypatch):
         from jax.experimental import pallas as pl
 

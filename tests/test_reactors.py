@@ -197,6 +197,7 @@ class TestEvidenceGossip:
 
 
 class TestBlocksync:
+    @pytest.mark.slow  # wall-clock blocksync on live threads
     def test_late_joiner_blocksyncs_to_head(self, net, tmp_path):
         """A fresh non-validator node joins after the chain has advanced and
         catches up via the blocksync pool (two-block verify pipeline)."""

@@ -23,6 +23,7 @@ def _fixture(n, seed_tag=b"secp"):
 
 
 class TestDeviceLadder:
+    @pytest.mark.slow  # ~25s XLA compile of the device ladder
     def test_mixed_validity_matches_host(self):
         _, pubs, msgs, sigs = _fixture(6)
         # corrupt: flipped sig byte, wrong message, wrong pubkey
@@ -36,6 +37,7 @@ class TestDeviceLadder:
         ]
         assert bits.tolist() == host == [True, False, True, False, False, True]
 
+    @pytest.mark.slow  # ~19s XLA compile of the device ladder
     def test_structural_rejects(self):
         _, pubs, msgs, sigs = _fixture(4)
         sigs[0] = sigs[0][:32] + bytes(32)          # s = 0

@@ -1,0 +1,286 @@
+"""Deterministic simulation harness tests (cometbft_tpu/sim/).
+
+Everything here runs on virtual time — no wall-clock sleeps, no threads —
+so a 30-virtual-second partition scenario finishes in a few wall seconds
+and a failure reproduces byte-identically from its seed.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+
+import pytest
+
+from cometbft_tpu.sim import SimCluster, run_scenario
+from cometbft_tpu.sim.clock import SimTicker, VirtualClock
+from cometbft_tpu.consensus.ticker import TimeoutInfo
+
+
+# ----------------------------------------------------------------------
+# virtual clock / ticker units
+# ----------------------------------------------------------------------
+
+
+class TestVirtualClock:
+    def test_events_fire_in_time_order(self):
+        clock = VirtualClock()
+        fired = []
+        clock.call_later(3.0, lambda: fired.append("c"))
+        clock.call_later(1.0, lambda: fired.append("a"))
+        clock.call_later(2.0, lambda: fired.append("b"))
+        while clock.tick():
+            pass
+        assert fired == ["a", "b", "c"]
+        assert clock.now() == 3.0
+
+    def test_equal_times_fire_in_schedule_order(self):
+        clock = VirtualClock()
+        fired = []
+        for tag in ("first", "second", "third"):
+            clock.call_later(1.0, lambda t=tag: fired.append(t))
+        while clock.tick():
+            pass
+        assert fired == ["first", "second", "third"]
+
+    def test_cancel_is_honoured(self):
+        clock = VirtualClock()
+        fired = []
+        timer = clock.call_later(1.0, lambda: fired.append("x"))
+        clock.call_later(2.0, lambda: fired.append("y"))
+        timer.cancel()
+        while clock.tick():
+            pass
+        assert fired == ["y"]
+
+    def test_past_schedules_clamp_to_now(self):
+        clock = VirtualClock()
+        clock.call_later(5.0, lambda: None)
+        clock.tick()
+        timer = clock.call_at(1.0, lambda: None)  # 1.0 is in the past
+        assert timer.when == clock.now()
+
+
+class TestSimTicker:
+    def _mk(self):
+        clock = VirtualClock()
+        fired = []
+        ticker = SimTicker(clock, fired.append)
+        ticker.start()
+        return clock, ticker, fired
+
+    def test_fires_after_duration(self):
+        clock, ticker, fired = self._mk()
+        ticker.schedule_timeout(TimeoutInfo(1.5, 1, 0, 1))
+        while clock.tick():
+            pass
+        assert [ti.height for ti in fired] == [1]
+        assert clock.now() == 1.5
+
+    def test_later_hrs_replaces_pending(self):
+        clock, ticker, fired = self._mk()
+        ticker.schedule_timeout(TimeoutInfo(5.0, 1, 0, 1))
+        ticker.schedule_timeout(TimeoutInfo(1.0, 1, 1, 1))  # later round, sooner
+        while clock.tick():
+            pass
+        assert [(ti.round_,) for ti in fired] == [(1,)]
+
+    def test_stale_schedule_dropped(self):
+        clock, ticker, fired = self._mk()
+        ticker.schedule_timeout(TimeoutInfo(1.0, 2, 0, 1))
+        ticker.schedule_timeout(TimeoutInfo(0.1, 1, 0, 1))  # earlier height: stale
+        while clock.tick():
+            pass
+        assert [ti.height for ti in fired] == [2]
+
+    def test_stop_suppresses_fire(self):
+        clock, ticker, fired = self._mk()
+        ticker.schedule_timeout(TimeoutInfo(1.0, 1, 0, 1))
+        ticker.stop()
+        while clock.tick():
+            pass
+        assert fired == []
+
+
+# ----------------------------------------------------------------------
+# determinism proof
+# ----------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def test_same_seed_identical_trace_and_hashes(self, tmp_path):
+        """ISSUE acceptance: same (scenario, seed) twice ⇒ byte-identical
+        event traces and identical commit hashes."""
+        runs = []
+        for sub in ("a", "b"):
+            res = run_scenario(
+                "baseline", 42, root=tmp_path / sub, keep_cluster=True
+            )
+            hashes = [
+                res.cluster.commit_hash(h)
+                for h in range(1, res.target_height + 1)
+            ]
+            runs.append((res.trace, hashes, res.events))
+        assert runs[0][0] == runs[1][0], "event traces diverged"
+        assert runs[0][1] == runs[1][1], "commit hashes diverged"
+        assert runs[0][2] == runs[1][2]
+
+    def test_different_seeds_diverge(self, tmp_path):
+        """Distinct seeds must actually exercise distinct schedules (a
+        constant trace would make the determinism check vacuous)."""
+        r1 = run_scenario("baseline", 1, root=tmp_path / "s1")
+        r2 = run_scenario("baseline", 2, root=tmp_path / "s2")
+        assert r1.reached and r2.reached
+        assert r1.trace != r2.trace
+
+
+# ----------------------------------------------------------------------
+# fault scenarios
+# ----------------------------------------------------------------------
+
+
+class TestScenarios:
+    def test_minority_partition_heals_no_fork(self, tmp_path):
+        """4 validators, cut off f=1, heal: the cluster keeps committing
+        through the partition and the healed node catches up; the
+        agreement invariant holds throughout (raise_on_violation)."""
+        res = run_scenario(
+            "partition-minority", 42, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        assert min(res.heights) >= res.target_height
+
+    @pytest.mark.parametrize("seed", [42, 1337])
+    def test_partition_leader_two_seeds(self, tmp_path, seed):
+        """ISSUE acceptance: two different seeds on partition-leader both
+        commit >= 5 heights on 4 validators with invariants passing."""
+        res = run_scenario(
+            "partition-leader", seed, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached and res.target_height >= 5
+        assert res.commits_verified >= 4 * 5  # every node, every height
+        assert not res.violations
+
+    def test_crash_restart_rejoins(self, tmp_path):
+        """Crashed node restarts from its stores (WAL + Handshaker replay)
+        and rejoins; the wal-replay invariant validates the rebuild."""
+        res = run_scenario(
+            "crash-restart",
+            42,
+            root=tmp_path,
+            raise_on_violation=True,
+            keep_cluster=True,
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        assert any("restart node" in line for line in res.trace)
+        # the restarted node holds the canonical chain
+        cluster = res.cluster
+        for h in range(1, res.target_height + 1):
+            metas = {
+                n.block_store.load_block_meta(h).block_id.hash
+                for n in cluster.live_nodes()
+            }
+            assert len(metas) == 1, f"fork at height {h}"
+
+    def test_n_vals_override_reaches_action_generators(self, tmp_path):
+        """A --validators override must flow into the fault scripts: on a
+        7-node cluster the minority partition is f=2 nodes [5, 6], not the
+        default-sized scenario's single node [3]."""
+        res = run_scenario(
+            "partition-minority",
+            3,
+            root=tmp_path,
+            n_vals=7,
+            target_height=5,  # past the t=3.0 partition, so the script fires
+            raise_on_violation=True,
+        )
+        assert res.n_vals == 7 and len(res.heights) == 7
+        assert any("partition minority [5, 6]" in line for line in res.trace)
+
+    def test_message_storm_commits(self, tmp_path):
+        res = run_scenario("message-storm", 42, root=tmp_path,
+                           raise_on_violation=True)
+        assert res.reached
+        assert res.cluster is None  # default: cluster not retained
+
+
+# ----------------------------------------------------------------------
+# invariant checkers catch real violations
+# ----------------------------------------------------------------------
+
+
+class TestInvariantDetection:
+    def _committed_cluster(self, tmp_path):
+        res = run_scenario("baseline", 42, root=tmp_path, keep_cluster=True)
+        assert res.reached
+        return res.cluster
+
+    def test_forged_commit_signature_detected(self, tmp_path):
+        """Flip a byte in a stored seen-commit signature: the validity
+        invariant (production verify_commit path) must reject it."""
+        cluster = self._committed_cluster(tmp_path)
+        node = cluster.nodes[0]
+        commit = node.block_store.load_seen_commit(2)
+        forged = copy.deepcopy(commit)
+        idx = next(
+            i for i, cs in enumerate(forged.signatures) if cs.signature
+        )
+        sig = bytearray(forged.signatures[idx].signature)
+        sig[0] ^= 0xFF
+        forged.signatures[idx] = dataclasses.replace(
+            forged.signatures[idx], signature=bytes(sig)
+        )
+        node.block_store.save_seen_commit(2, forged)
+
+        cluster.raise_on_violation = False
+        cluster.checker._checked[0] = 0  # force re-verification from genesis
+        cluster.checker.on_event(cluster)
+        assert any(
+            v.invariant == "validity" for v in cluster.checker.violations
+        ), cluster.checker.violations
+
+    def test_fork_detected_as_agreement_violation(self, tmp_path):
+        """Teach the checker a different canonical hash for a height: the
+        next sweep must flag every node as forked."""
+        cluster = self._committed_cluster(tmp_path)
+        cluster.raise_on_violation = False
+        cluster.checker.canonical[3] = b"\x00" * 32
+        cluster.checker._checked = {}
+        cluster.checker.on_event(cluster)
+        agreements = [
+            v for v in cluster.checker.violations if v.invariant == "agreement"
+        ]
+        assert len(agreements) == cluster.n_vals
+
+
+# ----------------------------------------------------------------------
+# soak (slow)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestSoak:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_partition_minority_seed_sweep(self, tmp_path, seed):
+        res = run_scenario(
+            "partition-minority",
+            seed,
+            root=tmp_path,
+            raise_on_violation=True,
+        )
+        assert res.reached, f"seed {seed}: heights {res.heights}"
+        assert not res.violations
+
+    def test_long_baseline_soak(self, tmp_path):
+        res = run_scenario(
+            "baseline",
+            99,
+            root=tmp_path,
+            target_height=30,
+            max_time=600.0,
+            raise_on_violation=True,
+        )
+        assert res.reached
+        assert not res.violations

@@ -209,7 +209,14 @@ def _make_commit(privs, vals, bid, height=3, nil_indices=(), skip_indices=()):
     return vs.make_commit()
 
 
-@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+@pytest.mark.parametrize(
+    "backend",
+    [
+        "cpu",
+        # the tpu path pays ~60s of XLA compile on a CPU-only host
+        pytest.param("tpu", marks=pytest.mark.slow),
+    ],
+)
 def test_verify_commit_ok(backend):
     privs, vals, _ = _mk_validators(4)
     bid = _block_id()

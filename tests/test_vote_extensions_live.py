@@ -9,6 +9,8 @@ height's PrepareProposal receives the extensions in local_last_commit
 
 import time
 
+import pytest
+
 from cometbft_tpu.abci import types as at
 from cometbft_tpu.abci.kvstore import KVStoreApplication
 from cometbft_tpu.cmd.main import main as cli_main
@@ -144,6 +146,7 @@ def test_extensions_flow_into_next_proposal(tmp_path):
     assert err is not None and "extension signature" in err
 
 
+@pytest.mark.slow  # wall-clock blocksync + catchup on live threads
 def test_late_joining_validator_proposes_after_blocksync(tmp_path):
     """With extensions enabled, a validator that joins late catches up
     via blocksync — which now carries extended commits — and can then
