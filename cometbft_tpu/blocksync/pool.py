@@ -132,6 +132,22 @@ class BlockPool:
                 first.ext_commit if first else None,
             )
 
+    def peek_window(self, k: int):
+        """The consecutive run of received blocks at the frontier, up to
+        ``k`` heights past it: [(height, block, peer_id, ext_commit), ...]
+        starting at ``self.height``, stopping at the first gap.  Feeds the
+        reactor's fused verification prefetch — block H's commit rides in
+        block H+1, so a window of n blocks lets n-1 commits be verified in
+        one device dispatch instead of n-1."""
+        with self._lock:
+            out = []
+            for h in range(self.height, self.height + max(k, 0) + 1):
+                req = self.requests.get(h)
+                if req is None or req.block is None:
+                    break
+                out.append((h, req.block, req.peer_id, req.ext_commit))
+            return out
+
     def pop_request(self) -> None:
         """First block verified + applied: advance the frontier."""
         with self._lock:

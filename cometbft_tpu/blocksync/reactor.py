@@ -11,11 +11,15 @@ On completion it hands off to consensus (SwitchToConsensus).
 
 from __future__ import annotations
 
+import hashlib
+import os
 import threading
 import time
 from typing import Optional
 
 from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto import sigcache
 from cometbft_tpu.libs import log as liblog
 from cometbft_tpu.libs import protoenc as pe
 from cometbft_tpu.p2p.conn import ChannelDescriptor
@@ -35,6 +39,17 @@ _MSG_STATUS_RESPONSE = 5
 _STATUS_INTERVAL = 5.0
 _SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 _POOL_TICK = 0.02
+
+# Fused-verification window: how many frontier commits may share one device
+# dispatch (COMETBFT_TPU_BLOCKSYNC_WINDOW; <2 disables the prefetch).
+_DEFAULT_WINDOW = 8
+
+
+def _window_k() -> int:
+    try:
+        return int(os.environ.get("COMETBFT_TPU_BLOCKSYNC_WINDOW", str(_DEFAULT_WINDOW)))
+    except ValueError:
+        return _DEFAULT_WINDOW
 
 
 def _enc(kind: int, body: bytes = b"") -> bytes:
@@ -64,6 +79,9 @@ class BlocksyncReactor(Reactor):
         self.pool = BlockPool(start, self._send_block_request, self.logger)
         self._thread: Optional[threading.Thread] = None
         self.synced_at: Optional[float] = None
+        # fused-prefetch memo: commit fingerprint -> height, so a window is
+        # dispatched once and apply/redo ticks never re-dispatch it
+        self._fused: dict[bytes, int] = {}
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [
@@ -214,9 +232,128 @@ class BlocksyncReactor(Reactor):
                 self.logger.error("blocksync pool error", err=repr(e))
                 time.sleep(0.5)
 
+    # -- fused window prefetch --------------------------------------------
+
+    @staticmethod
+    def _commit_fingerprint(height: int, commit) -> bytes:
+        """Cheap per-tick memo key: O(1) in validator count (hashing all
+        10k signatures every 20 ms pool tick would be ~MBs of SHA-256 per
+        tick once the window is already fused).  A redo replaces the whole
+        served commit, so height + block id + round + size + the first and
+        last signatures distinguish every case that matters; a collision
+        merely skips a SPECULATIVE prefetch — the authoritative sequential
+        verification is unaffected."""
+        h = hashlib.sha256()
+        h.update(height.to_bytes(8, "little", signed=True))
+        h.update(commit.block_id.hash)
+        h.update(commit.round_.to_bytes(4, "little", signed=True))
+        h.update(len(commit.signatures).to_bytes(4, "little"))
+        if commit.signatures:
+            first, last = commit.signatures[0], commit.signatures[-1]
+            h.update(bytes([first.block_id_flag]))
+            h.update(first.signature)
+            h.update(bytes([last.block_id_flag]))
+            h.update(last.signature)
+        return h.digest()
+
+    def _prefetch_window(self) -> None:
+        """Speculatively verify a window of frontier commits in ONE fused
+        device dispatch (ops.verify.verify_segments), seeding the signature
+        cache so the authoritative per-height ``verify_commit_light`` in
+        ``_process_blocks`` resolves without re-dispatching.
+
+        Safety: verdicts are keyed on the full (pub, msg, sig) triple.  A
+        misprediction (validator set changed mid-window) caches triples the
+        real verification never queries — it degrades to today's one-
+        dispatch-per-height behavior, never to a wrong answer.  Block
+        *application* stays strictly sequential in ``_process_blocks``;
+        a bad block still takes the same redo/ban path there."""
+        k = _window_k()
+        if k < 2 or not sigcache.SigCache.enabled():
+            return
+        if cbatch.default_backend() != "tpu":
+            # no trusted accelerator: the per-commit host library path is
+            # already optimal, and the XLA-CPU kernel would be a regression
+            return
+        peek = getattr(self.pool, "peek_window", None)
+        if peek is None:
+            return
+        window = peek(k)
+        if len(window) < 3:
+            return  # the two-block pipeline covers short runs
+        from cometbft_tpu.crypto import keys as ck
+
+        if not all(
+            getattr(v.pub_key, "type_", None) == ck.ED25519_KEY_TYPE
+            for v in self.state.validators.validators
+        ):
+            return  # fused kernel is ed25519-only
+        to_fuse = []  # (fingerprint, height, prepared, bits, miss_indices)
+        for i in range(len(window) - 1):
+            h = window[i][0]
+            commit = window[i + 1][1].last_commit
+            fp = self._commit_fingerprint(h, commit)
+            if fp in self._fused:
+                continue
+            # best-effort validator-set prediction past the frontier; a miss
+            # is safe (see docstring)
+            vals = self.state.validators if i == 0 else self.state.next_validators
+            try:
+                # count_all: cover the full-verification superset, so both
+                # the frontier verify_commit_light AND validate_block's
+                # apply-time verify_commit resolve from cache
+                prepared = validation.prepare_commit_light(
+                    self.state.chain_id,
+                    vals,
+                    commit.block_id,
+                    h,
+                    commit,
+                    count_all=True,
+                )
+            except validation.CommitVerificationError:
+                continue  # malformed: let the sequential path raise/redo/ban
+            bits, miss = sigcache.partition_misses(
+                prepared.pubs, prepared.msgs, prepared.sigs
+            )
+            if not miss:
+                self._fused[fp] = h  # fully cached already
+                continue
+            to_fuse.append((fp, h, prepared, bits, miss))
+        if not to_fuse:
+            return
+        from cometbft_tpu.ops import verify as ov
+
+        try:
+            results = ov.verify_segments(
+                [
+                    (
+                        [p.pubs[j] for j in miss],
+                        [p.msgs[j] for j in miss],
+                        [p.sigs[j] for j in miss],
+                    )
+                    for _, _, p, _, miss in to_fuse
+                ]
+            )
+        except Exception as e:  # noqa: BLE001 — prefetch must never stall sync
+            self.logger.error("fused verify prefetch failed", err=repr(e))
+            return
+        for (fp, h, p, bits, miss), got in zip(to_fuse, results):
+            sigcache.writeback(p.pubs, p.msgs, p.sigs, bits, miss, got)
+            self._fused[fp] = h
+        # trim memo entries behind the frontier
+        frontier = self.pool.height
+        if len(self._fused) > 4 * max(k, 1):
+            self._fused = {
+                fp: h for fp, h in self._fused.items() if h >= frontier
+            }
+
     def _process_blocks(self) -> bool:
         """Verify + apply the frontier block using the NEXT block's
         LastCommit (reference: reactor.go:541)."""
+        try:
+            self._prefetch_window()
+        except Exception as e:  # noqa: BLE001 — speculative only
+            self.logger.error("blocksync prefetch error", err=repr(e))
         first, second, first_peer, second_peer, first_ext = (
             self.pool.peek_two_blocks()
         )

@@ -867,8 +867,14 @@ class ConsensusState(BaseService):
         ):
             raise VoteError("invalid proposal POL round")
         proposer = rs.validators.get_proposer()
-        if not proposer.pub_key.verify_signature(
-            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        # through the signature cache: a proposal regossiped by several
+        # peers (or replayed from the WAL) is verified once per process
+        from cometbft_tpu.crypto import sigcache
+
+        if not sigcache.verify_with_cache(
+            proposer.pub_key,
+            proposal.sign_bytes(self.state.chain_id),
+            proposal.signature,
         ):
             raise VoteError("invalid proposal signature")
         rs.proposal = proposal
@@ -1055,8 +1061,13 @@ class ConsensusState(BaseService):
         )
         if val is None or val[1] is None:
             return False
+        from cometbft_tpu.crypto import sigcache
+
         pub = val[1].pub_key
-        if not vote.extension_signature or not pub.verify_signature(
+        # cached: blocksync's check_ext_commit re-verifies these same
+        # extension signatures when serving/validating extended commits
+        if not vote.extension_signature or not sigcache.verify_with_cache(
+            pub,
             vote.extension_sign_bytes(self.state.chain_id),
             vote.extension_signature,
         ):

@@ -107,6 +107,24 @@ class BatchVerifier:
 
 
 class _CollectingVerifier(BatchVerifier):
+    """Shared collection + the two pre-device filters every backend wants:
+
+    1. signature-cache prefilter — verdicts already known (e.g. votes
+       verified at gossip time) never reach the backend again; only cache
+       MISSES are verified, and fresh verdicts are written back;
+    2. structural short-circuit — entries whose pub/sig lengths make them
+       impossible for the key type are resolved to False on the host, so a
+       batch of garbage does not occupy real device lanes (or inflate the
+       padding bucket).
+
+    Subclasses implement ``_verify_pending(pubs, msgs, sigs)`` over the
+    surviving entries.  ``COMETBFT_TPU_SIGCACHE=0`` turns filter 1 off,
+    restoring uncached behavior exactly (filter 2 only resolves entries
+    every backend already reports False for)."""
+
+    PUB_SIZES: tuple = ()  # empty = no structural filter on that field
+    SIG_SIZES: tuple = ()
+
     def __init__(self):
         self.pubs: list[bytes] = []
         self.msgs: list[bytes] = []
@@ -121,27 +139,51 @@ class _CollectingVerifier(BatchVerifier):
     def __len__(self) -> int:
         return len(self.pubs)
 
+    def _verify_pending(
+        self, pubs: list[bytes], msgs: list[bytes], sigs: list[bytes]
+    ) -> list[bool]:
+        raise NotImplementedError
 
-class CpuBatchVerifier(_CollectingVerifier):
-    def verify(self) -> tuple[bool, list[bool]]:
-        bits = [
-            ck.Ed25519PubKey(p).verify_signature(m, s)
-            if len(p) == 32
-            else False
-            for p, m, s in zip(self.pubs, self.msgs, self.sigs)
-        ]
-        return all(bits) and len(bits) > 0, bits
-
-
-class TpuBatchVerifier(_CollectingVerifier):
     def verify(self) -> tuple[bool, list[bool]]:
         if not self.pubs:
             return False, []
+        from cometbft_tpu.crypto import sigcache
+
+        bits, pending = sigcache.partition_misses(
+            self.pubs, self.msgs, self.sigs, self.PUB_SIZES, self.SIG_SIZES
+        )
+        if pending:
+            got = self._verify_pending(
+                [self.pubs[i] for i in pending],
+                [self.msgs[i] for i in pending],
+                [self.sigs[i] for i in pending],
+            )
+            sigcache.writeback(
+                self.pubs, self.msgs, self.sigs, bits, pending, got
+            )
+        bits = [bool(b) for b in bits]
+        return all(bits) and len(bits) > 0, bits
+
+
+class CpuBatchVerifier(_CollectingVerifier):
+    PUB_SIZES = (32,)
+    SIG_SIZES = (64,)
+
+    def _verify_pending(self, pubs, msgs, sigs) -> list[bool]:
+        return [
+            ck.Ed25519PubKey(p).verify_signature(m, s)
+            for p, m, s in zip(pubs, msgs, sigs)
+        ]
+
+
+class TpuBatchVerifier(_CollectingVerifier):
+    PUB_SIZES = (32,)
+    SIG_SIZES = (64,)
+
+    def _verify_pending(self, pubs, msgs, sigs) -> list[bool]:
         from cometbft_tpu.ops import verify as _ops_verify
 
-        bits = _ops_verify.verify_batch(self.pubs, self.msgs, self.sigs)
-        bits = [bool(b) for b in bits]
-        return all(bits), bits
+        return [bool(b) for b in _ops_verify.verify_batch(pubs, msgs, sigs)]
 
 
 _SECP_DEVICE_OK: Optional[bool] = None
@@ -190,22 +232,19 @@ class Secp256k1BatchVerifier(_CollectingVerifier):
     Falls back to the host `cryptography` library when the device fails
     its self-check or ``backend='cpu'`` pins it off."""
 
+    PUB_SIZES = (33,)
+    SIG_SIZES = (64,)
+
     def __init__(self, backend: Optional[str] = None):
         super().__init__()
         self._backend = backend
 
-    def verify(self) -> tuple[bool, list[bool]]:
-        if not self.pubs:
-            return False, []
+    def _verify_pending(self, pubs, msgs, sigs) -> list[bool]:
         if self._backend != "cpu" and _secp_device_ok():
             try:
                 from cometbft_tpu.ops import secp_verify as sv
 
-                bits = [
-                    bool(b)
-                    for b in sv.verify_batch(self.pubs, self.msgs, self.sigs)
-                ]
-                return all(bits), bits
+                return [bool(b) for b in sv.verify_batch(pubs, msgs, sigs)]
             except Exception:
                 logging.getLogger("cometbft_tpu.crypto").exception(
                     "device secp verify failed; host fallback"
@@ -213,12 +252,12 @@ class Secp256k1BatchVerifier(_CollectingVerifier):
         from cometbft_tpu.crypto.secp256k1 import Secp256k1PubKey
 
         bits = []
-        for p, m, s in zip(self.pubs, self.msgs, self.sigs):
+        for p, m, s in zip(pubs, msgs, sigs):
             try:
                 bits.append(Secp256k1PubKey(p).verify_signature(m, s))
             except ValueError:
                 bits.append(False)
-        return all(bits) and len(bits) > 0, bits
+        return bits
 
 
 _BLS_DEVICE_OK: Optional[bool] = None
@@ -285,25 +324,26 @@ class BlsBatchVerifier(_CollectingVerifier):
     crypto.backend / COMETBFT_TPU_CRYPTO_BACKEND) pins the scalar-mul work
     to the host regardless of the device self-check."""
 
+    PUB_SIZES = (96,)  # bls12381.PUB_KEY_SIZE (uncompressed G1)
+    SIG_SIZES = (96,)  # bls12381.SIGNATURE_SIZE (compressed G2)
+
     def __init__(self, backend: Optional[str] = None):
         super().__init__()
         self._backend = backend
 
-    def verify(self) -> tuple[bool, list[bool]]:
+    def _verify_pending(self, pubs, msgs, sigs) -> list[bool]:
         import secrets
 
         from cometbft_tpu.crypto import bls12381 as bls
 
-        n = len(self.pubs)
-        if n == 0:
-            return False, []
+        n = len(pubs)
         lib = bls._nat()
         if lib is not None:
-            return self._verify_native(lib)
+            return self._verify_native(lib, pubs, msgs, sigs)
         bits = [False] * n
         entries = []  # (index, pk_jac, h_jac, sig_jac)
         for i in range(n):
-            pub, msg, sig = self.pubs[i], self.msgs[i], self.sigs[i]
+            pub, msg, sig = pubs[i], msgs[i], sigs[i]
             if len(pub) != bls.PUB_KEY_SIZE or len(sig) != bls.SIGNATURE_SIZE:
                 continue
             pk = bls.g1_deserialize(pub)
@@ -314,11 +354,11 @@ class BlsBatchVerifier(_CollectingVerifier):
                 continue
             entries.append((i, pk, bls.hash_to_g2(msg), s))
         if not entries:
-            return False, bits
+            return bits
         if len(entries) == 1:
             i, _, _, _ = entries[0]
-            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
-            return all(bits), bits
+            bits[i] = bls.verify(pubs[i], msgs[i], sigs[i])
+            return bits
 
         rs = [secrets.randbits(128) | 1 for _ in entries]
         scaled = self._scaled_pubkeys(
@@ -335,11 +375,12 @@ class BlsBatchVerifier(_CollectingVerifier):
         if bls._pairing_product_is_one(pairs):
             for i, _, _, _ in entries:
                 bits[i] = True
-            return all(bits), bits
+            return bits
         # attribution fallback: the combination failed, find the culprits
-        return self._per_signature([e[0] for e in entries], bits)
+        return self._per_signature(pubs, msgs, sigs, [e[0] for e in entries], bits)
 
-    def _per_signature(self, entries, bits) -> tuple[bool, list[bool]]:
+    @staticmethod
+    def _per_signature(pubs, msgs, sigs, entries, bits) -> list[bool]:
         """Verify each structurally-valid entry on its own.  This is the
         refuge when a native batch op errors: such an error is an
         infrastructure failure, not evidence against any signature, so it
@@ -348,10 +389,10 @@ class BlsBatchVerifier(_CollectingVerifier):
         from cometbft_tpu.crypto import bls12381 as bls
 
         for i in entries:
-            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
-        return all(bits), bits
+            bits[i] = bls.verify(pubs[i], msgs[i], sigs[i])
+        return bits
 
-    def _verify_native(self, lib) -> tuple[bool, list[bool]]:
+    def _verify_native(self, lib, pubs, msgs, sigs) -> list[bool]:
         """RLC batch verification with every host-side group/pairing op in
         the native library; the TPU G1 MSM still handles the rᵢ·pkᵢ
         multi-scalar-mul when the device passes its self-check.  Same
@@ -362,11 +403,11 @@ class BlsBatchVerifier(_CollectingVerifier):
 
         from cometbft_tpu.crypto import bls12381 as bls
 
-        n = len(self.pubs)
+        n = len(pubs)
         bits = [False] * n
         entries = []  # index of each structurally-valid (pub, msg, sig)
         for i in range(n):
-            pub, sig = self.pubs[i], self.sigs[i]
+            pub, sig = pubs[i], sigs[i]
             if len(pub) != bls.PUB_KEY_SIZE or len(sig) != bls.SIGNATURE_SIZE:
                 continue
             if lib.bls_pubkey_validate(pub, len(pub)) != 1:
@@ -375,11 +416,11 @@ class BlsBatchVerifier(_CollectingVerifier):
                 continue
             entries.append(i)
         if not entries:
-            return False, bits
+            return bits
         if len(entries) == 1:
             i = entries[0]
-            bits[i] = bls.verify(self.pubs[i], self.msgs[i], self.sigs[i])
-            return all(bits), bits
+            bits[i] = bls.verify(pubs[i], msgs[i], sigs[i])
+            return bits
 
         rs = [secrets.randbits(128) | 1 for _ in entries]
         r_bytes = [r.to_bytes(16, "big") for r in rs]
@@ -387,14 +428,14 @@ class BlsBatchVerifier(_CollectingVerifier):
         # rᵢ·pkᵢ — TPU MSM when trusted, else native scalar mul
         g1_parts = []
         if self._backend != "cpu" and _bls_device_ok():
-            pks = [bls.g1_deserialize(self.pubs[i]) for i in entries]
+            pks = [bls.g1_deserialize(pubs[i]) for i in entries]
             for pt in self._scaled_pubkeys(pks, rs, self._backend):
                 g1_parts.append(bls.g1_serialize(bls.E1.neg_pt(pt)))
         else:
             for i, rb in zip(entries, r_bytes):
                 out = ctypes.create_string_buffer(96)
-                if lib.bls_g1_scalar_mul(self.pubs[i], rb, 16, out) != 0:
-                    return self._per_signature(entries, bits)
+                if lib.bls_g1_scalar_mul(pubs[i], rb, 16, out) != 0:
+                    return self._per_signature(pubs, msgs, sigs, entries, bits)
                 g1_parts.append(bls.g1_negate_serialized(out.raw))
 
         # Σ rᵢ·Sᵢ and H(mᵢ), all native
@@ -402,19 +443,19 @@ class BlsBatchVerifier(_CollectingVerifier):
         hashes = []
         for i, rb in zip(entries, r_bytes):
             so = ctypes.create_string_buffer(96)
-            if lib.bls_g2_scalar_mul_compressed(self.sigs[i], rb, 16, so) != 0:
-                return self._per_signature(entries, bits)
+            if lib.bls_g2_scalar_mul_compressed(sigs[i], rb, 16, so) != 0:
+                return self._per_signature(pubs, msgs, sigs, entries, bits)
             scaled_sigs.append(so.raw)
             ho = ctypes.create_string_buffer(96)
-            msg = self.msgs[i]
+            msg = msgs[i]
             if lib.bls_hash_to_g2(msg, len(msg), ho) != 0:
-                return self._per_signature(entries, bits)
+                return self._per_signature(pubs, msgs, sigs, entries, bits)
             hashes.append(ho.raw)
         agg = ctypes.create_string_buffer(96)
         if lib.bls_aggregate_sigs(
             b"".join(scaled_sigs), len(scaled_sigs), agg
         ) != 0:
-            return self._per_signature(entries, bits)
+            return self._per_signature(pubs, msgs, sigs, entries, bits)
 
         from cometbft_tpu.crypto.bls12381 import G1_GEN, g1_serialize
 
@@ -425,9 +466,9 @@ class BlsBatchVerifier(_CollectingVerifier):
         ) == 1:
             for i in entries:
                 bits[i] = True
-            return all(bits), bits
+            return bits
         # attribution fallback: the combination failed, find the culprits
-        return self._per_signature(entries, bits)
+        return self._per_signature(pubs, msgs, sigs, entries, bits)
 
     @staticmethod
     def _scaled_pubkeys(pks, rs, backend: Optional[str] = None):

@@ -146,6 +146,10 @@ class LightClient:
 
     # -- sequential (reference: client.go:608) -----------------------------
 
+    # headers per pipelined window: enough to amortize the per-dispatch
+    # floor, small enough to bound wasted work past a bad header
+    SEQ_WINDOW = 8
+
     def _verify_sequential(
         self,
         trusted: LightBlock,
@@ -153,23 +157,31 @@ class LightClient:
         now: float,
         verified: list,
     ) -> None:
+        """Windows of up to SEQ_WINDOW headers go through
+        ``verify_adjacent_chain``: next-header host prep overlaps the
+        in-flight commit dispatch (``ops.verify.verify_batches_overlapped``)
+        instead of blocking on one height at a time.  Error behavior per
+        header is that of ``verify_adjacent``; nothing from a failed window
+        is appended to ``verified``."""
         current = trusted
-        for h in range(trusted.height + 1, target.height + 1):
-            lb = (
+        heights = list(range(trusted.height + 1, target.height + 1))
+        for w in range(0, len(heights), self.SEQ_WINDOW):
+            chunk = [
                 target
                 if h == target.height
                 else self.primary.light_block(h)
-            )
-            lv.verify_adjacent(
+                for h in heights[w : w + self.SEQ_WINDOW]
+            ]
+            lv.verify_adjacent_chain(
                 self.chain_id,
                 current,
-                lb,
+                chunk,
                 self.trust_options.period_s,
                 now,
                 self.max_clock_drift_s,
             )
-            verified.append(lb)
-            current = lb
+            verified.extend(chunk)
+            current = chunk[-1]
 
     # -- skipping / bisection (reference: client.go:701) -------------------
 

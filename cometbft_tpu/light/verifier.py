@@ -87,6 +87,29 @@ def verify_adjacent(
     max_clock_drift_s: float = 10.0,
 ) -> None:
     """Reference: light/verifier.go:91 VerifyAdjacent."""
+    _check_adjacent_headers(
+        chain_id, trusted, new, trusting_period_s, now, max_clock_drift_s
+    )
+    validation.verify_commit_light(
+        chain_id,
+        new.validator_set,
+        new.signed_header.commit.block_id,
+        new.height,
+        new.signed_header.commit,
+    )
+
+
+def _check_adjacent_headers(
+    chain_id: str,
+    trusted: LightBlock,
+    new: LightBlock,
+    trusting_period_s: int,
+    now: float,
+    max_clock_drift_s: float,
+) -> None:
+    """Every check ``verify_adjacent`` performs EXCEPT the commit signature
+    verification — the host half, ONE copy shared by the sequential path
+    (``verify_adjacent`` calls this) and the pipelined chain path."""
     if new.height != trusted.height + 1:
         raise ErrInvalidHeader("headers must be adjacent")
     if header_expired(trusted.signed_header.header.time, trusting_period_s, now):
@@ -99,13 +122,96 @@ def verify_adjacent(
         raise ErrInvalidHeader(
             "new validators hash does not match trusted next_validators_hash"
         )
-    validation.verify_commit_light(
-        chain_id,
-        new.validator_set,
-        new.signed_header.commit.block_id,
-        new.height,
-        new.signed_header.commit,
-    )
+
+
+def verify_adjacent_chain(
+    chain_id: str,
+    trusted: LightBlock,
+    news: "list[LightBlock]",
+    trusting_period_s: int,
+    now: float,
+    max_clock_drift_s: float = 10.0,
+) -> None:
+    """Verify a consecutive run of headers (trusted+1, trusted+2, ...) with
+    host/device overlap: every header's host work (adjacency + validator-
+    hash link + sign-bytes construction) runs up front, then all commit
+    batches are dispatched through ``ops.verify.verify_batches_overlapped``
+    — header i+1's host prep overlaps header i's in-flight dispatch, and on
+    backends that queue dispatches the kernels pipeline.  Judgement stays
+    strictly in order, so the raised error class matches what sequential
+    ``verify_adjacent`` raises for that header (when several headers are
+    independently bad, the chain may surface a later header's *structural*
+    error before an earlier header's *signature* error — either way the
+    sync aborts and nothing is trusted).
+
+    Falls back to the plain sequential loop when the accelerator batch
+    backend is off or a validator set is not uniformly ed25519."""
+    from cometbft_tpu.crypto import batch as cbatch
+    from cometbft_tpu.crypto import keys as ck
+    from cometbft_tpu.crypto import sigcache
+    from cometbft_tpu.types import validation
+
+    if not news:
+        return
+
+    def _sequential() -> None:
+        current = trusted
+        for lb in news:
+            verify_adjacent(
+                chain_id, current, lb, trusting_period_s, now, max_clock_drift_s
+            )
+            current = lb
+
+    if len(news) < 2 or cbatch.default_backend() != "tpu":
+        return _sequential()
+
+    # host pass: adjacency checks + entry collection for every header
+    prepared = []
+    current = trusted
+    for lb in news:
+        _check_adjacent_headers(
+            chain_id, current, lb, trusting_period_s, now, max_clock_drift_s
+        )
+        prepared.append(
+            validation.prepare_commit_light(
+                chain_id,
+                lb.validator_set,
+                lb.signed_header.commit.block_id,
+                lb.height,
+                lb.signed_header.commit,
+            )
+        )
+        current = lb
+    if not all(
+        getattr(v.pub_key, "type_", None) == ck.ED25519_KEY_TYPE
+        for p in prepared
+        for _, v, _ in p.entries
+    ):
+        return _sequential()  # fused kernel is ed25519-only
+
+    # device pass: ship only cache misses, one overlapped batch per header
+    per_header = []  # (prepared, bits-with-None-holes, miss_indices)
+    for p in prepared:
+        bits, miss = sigcache.partition_misses(p.pubs, p.msgs, p.sigs)
+        per_header.append((p, bits, miss))
+    from cometbft_tpu.ops import verify as ov
+
+    work = [
+        (
+            [p.pubs[j] for j in miss],
+            [p.msgs[j] for j in miss],
+            [p.sigs[j] for j in miss],
+        )
+        for p, _, miss in per_header
+        if miss
+    ]
+    fresh = iter(ov.verify_batches_overlapped(work) if work else [])
+
+    # judge strictly in order
+    for p, bits, miss in per_header:
+        if miss:
+            sigcache.writeback(p.pubs, p.msgs, p.sigs, bits, miss, next(fresh))
+        validation.finish_commit_light(p, bits)
 
 
 def verify_non_adjacent(

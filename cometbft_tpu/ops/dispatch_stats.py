@@ -1,0 +1,70 @@
+"""Process-wide counters for the batched-verify pipeline.
+
+Deliberately free of jax imports: ``libs/metrics.NodeMetrics`` reads these
+through callback gauges, and a /metrics scrape must never be the thing that
+initializes an accelerator backend.  ``ops/verify.py`` (and anything else
+that launches verify kernels) writes them.
+
+Counters:
+  * ``dispatches``       — device kernel launches
+  * ``lanes_total``      — bucket-padded lanes shipped across all dispatches
+  * ``lanes_used``       — lanes carrying a real signature (occupancy)
+  * ``fused_batches``    — verify_segments calls that fused >1 segment
+  * ``fused_segments``   — segments that rode in a fused dispatch
+  * ``verify_calls`` / ``verify_seconds`` — commit-verification latency
+    aggregate (observed by types/validation)
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+_STATS = {
+    "dispatches": 0,
+    "lanes_total": 0,
+    "lanes_used": 0,
+    "fused_batches": 0,
+    "fused_segments": 0,
+    "verify_calls": 0,
+    "verify_seconds": 0.0,
+}
+
+
+def record_dispatch(lanes_total: int, lanes_used: int) -> None:
+    with _LOCK:
+        _STATS["dispatches"] += 1
+        _STATS["lanes_total"] += int(lanes_total)
+        _STATS["lanes_used"] += int(lanes_used)
+
+
+def record_fused(n_segments: int) -> None:
+    with _LOCK:
+        _STATS["fused_batches"] += 1
+        _STATS["fused_segments"] += int(n_segments)
+
+
+def record_verify_latency(seconds: float) -> None:
+    with _LOCK:
+        _STATS["verify_calls"] += 1
+        _STATS["verify_seconds"] += float(seconds)
+
+
+def dispatch_count() -> int:
+    with _LOCK:
+        return _STATS["dispatches"]
+
+
+def snapshot() -> dict:
+    with _LOCK:
+        out = dict(_STATS)
+    out["occupancy"] = (
+        out["lanes_used"] / out["lanes_total"] if out["lanes_total"] else 0.0
+    )
+    return out
+
+
+def reset() -> None:
+    with _LOCK:
+        for k in _STATS:
+            _STATS[k] = 0.0 if k == "verify_seconds" else 0

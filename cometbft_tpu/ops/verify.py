@@ -28,20 +28,33 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from cometbft_tpu.ops import dispatch_stats
 from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import ed25519_point as ep
 
 L_INT = 2**252 + 27742317777372353535851937790883648493
 
-# Batch buckets: pad to one of these sizes to bound recompilation.
-_BUCKETS = [128, 256, 512, 1024, 2048, 4096, 8192, 10240, 16384, 32768]
+# Batch buckets: pad to one of these sizes to bound recompilation.  The
+# sub-128 buckets exist for the plain-XLA path only — a 4-validator commit
+# costs a 32-lane kernel instead of a 128-lane one (the XLA-CPU build runs
+# lanes ~linearly, so small-bucket dispatches are ~4-5x faster, which is
+# what keeps the CPU test suite inside its budget).  Pallas keeps a
+# 128-lane floor: the Mosaic lowering tiles on the 8x128 lane grid.
+_BUCKETS = [32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 10240, 16384, 32768]
+_PALLAS_MIN_BUCKET = 128
 
 
-def bucket_size(n: int) -> int:
+def bucket_size(n: int, min_bucket: int = _PALLAS_MIN_BUCKET) -> int:
     for b in _BUCKETS:
+        if b < min_bucket:
+            continue
         if n <= b:
             return b
     return ((n + _BUCKETS[-1] - 1) // _BUCKETS[-1]) * _BUCKETS[-1]
+
+
+def _min_bucket() -> int:
+    return _PALLAS_MIN_BUCKET if _use_pallas() else _BUCKETS[0]
 
 
 def verify_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
@@ -135,18 +148,24 @@ def _verify_kernel_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
 
 
 def prepare_batch(
-    pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
+    pubs: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    min_bucket: int = _PALLAS_MIN_BUCKET,
 ):
     """Host-side packing.  Returns (arrays, n, structural_ok): ``arrays``
     holds the padded uint8 device inputs and structural_ok marks
-    length-valid entries.
+    length-valid entries.  ``min_bucket`` floors the padding bucket —
+    callers that might run the Pallas kernel (or shard across a mesh) keep
+    the conservative 128 default; the plain-XLA single-chip path passes
+    the small-bucket floor.
 
     The per-signature SHA-512 + mod-L math runs in the C++ sidecar when
     available (cometbft_tpu/native — the host half of the verify pipeline);
     the Python loop below is the fallback and the differential oracle for it.
     """
     n = len(pubs)
-    b = bucket_size(max(n, 1))
+    b = bucket_size(max(n, 1), min_bucket)
     pub_arr = np.zeros((b, 32), np.uint8)
     r_arr = np.zeros((b, 32), np.uint8)
     s_bytes = np.zeros((b, 32), np.uint8)
@@ -225,8 +244,9 @@ def verify_batch(
     pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
     """Verify a batch; returns (n,) bool numpy array of per-signature results."""
-    arrays, n, structural = prepare_batch(pubs, msgs, sigs)
+    arrays, n, structural = prepare_batch(pubs, msgs, sigs, _min_bucket())
     kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
+    dispatch_stats.record_dispatch(arrays["s_ok"].shape[0], n)
     accept = np.asarray(
         kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
     )
@@ -247,11 +267,54 @@ def verify_batches_overlapped(
 
     Returns a list of (n,) bool arrays, one per input batch."""
     kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
+    min_b = _min_bucket()
     inflight = []  # (device result, n, structural)
     for pubs, msgs, sigs in work:
-        arrays, n, structural = prepare_batch(pubs, msgs, sigs)
+        arrays, n, structural = prepare_batch(pubs, msgs, sigs, min_b)
+        dispatch_stats.record_dispatch(arrays["s_ok"].shape[0], n)
         dev = kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
         inflight.append((dev, n, structural))  # no block: async dispatch
     return [
         (np.asarray(dev) & structural)[:n] for dev, n, structural in inflight
     ]
+
+
+def verify_segments(
+    work: "Sequence[tuple[Sequence[bytes], Sequence[bytes], Sequence[bytes]]]",
+) -> "list[np.ndarray]":
+    """Fused multi-segment verification: concatenate several (pubs, msgs,
+    sigs) segments into ONE bucket-padded device batch and split the accept
+    bits back out per segment, so K consecutive commits cost one dispatch
+    instead of K (bench.py's ``dispatch_floor_ms`` is otherwise paid per
+    height).  Bitwise-equal to calling ``verify_batch`` per segment: every
+    lane is verified independently, so fusing cannot couple results across
+    segments (tests/test_verify_stream.py pins this property).
+
+    Falls back to ``verify_batches_overlapped`` when the concatenation
+    would overflow the largest bucket — past that size there is no single
+    dispatch to fuse into, and the overlapped pipeline is the next-best
+    amortization.
+
+    Returns a list of (n_i,) bool arrays, one per input segment."""
+    sizes = [len(p) for p, _, _ in work]
+    total = sum(sizes)
+    if total == 0:
+        return [np.zeros(0, dtype=bool) for _ in work]
+    if total > _BUCKETS[-1]:
+        return verify_batches_overlapped(work)
+    pubs: list = []
+    msgs: list = []
+    sigs: list = []
+    for p, m, s in work:
+        pubs.extend(p)
+        msgs.extend(m)
+        sigs.extend(s)
+    if len(work) > 1:
+        dispatch_stats.record_fused(len(work))
+    bits = verify_batch(pubs, msgs, sigs)
+    out = []
+    off = 0
+    for n in sizes:
+        out.append(bits[off : off + n])
+        off += n
+    return out

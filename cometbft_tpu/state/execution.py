@@ -270,12 +270,22 @@ class BlockExecutor:
         if h.consensus_hash != consensus_params_hash(state.consensus_params):
             raise InvalidBlockError("consensus params hash mismatch")
 
-        # LastCommit verification — THE hot path (§3.4): batch Ed25519 on TPU.
+        # LastCommit verification — THE hot path (§3.4): batch Ed25519 on
+        # TPU, pre-filtered by the consensus-wide signature cache: votes
+        # already verified at gossip time (vote_set.add_vote) resolve as
+        # cache hits, so a commit assembled from our own vote set re-verifies
+        # without any device dispatch.
         if h.height > state.initial_height:
             if block.last_commit.size() != len(state.last_validators):
                 raise InvalidBlockError(
                     "last commit size != last validator set size"
                 )
+            import time as _time
+
+            from cometbft_tpu.crypto import sigcache
+
+            before = sigcache.get_cache().stats()
+            t0 = _time.perf_counter()
             validation.verify_commit(
                 state.chain_id,
                 state.last_validators,
@@ -283,6 +293,15 @@ class BlockExecutor:
                 h.height - 1,
                 block.last_commit,
             )
+            if self.logger is not None:
+                after = sigcache.get_cache().stats()
+                self.logger.debug(
+                    "last commit verified",
+                    height=h.height,
+                    elapsed_ms=round((_time.perf_counter() - t0) * 1e3, 2),
+                    cache_hits=after["hits"] - before["hits"],
+                    cache_misses=after["misses"] - before["misses"],
+                )
         elif block.last_commit.size() != 0:
             raise InvalidBlockError("initial block must have empty last commit")
 

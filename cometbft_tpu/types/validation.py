@@ -10,10 +10,14 @@ one kernel launch; per-signature accept bits make failure attribution free
 
 from __future__ import annotations
 
+import time
+from dataclasses import dataclass, field
 from fractions import Fraction
 from typing import Optional
 
 from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto import sigcache
+from cometbft_tpu.ops import dispatch_stats
 from cometbft_tpu.types.basic import BLOCK_ID_FLAG_ABSENT, BlockID
 from cometbft_tpu.types.block import Commit
 from cometbft_tpu.types.validator import ValidatorSet
@@ -69,24 +73,20 @@ def _should_batch(vals: ValidatorSet, commit: Commit) -> bool:
     return all(cbatch.supports_batch_verifier(v.pub_key) for v in vals.validators)
 
 
-def _verify_commit(
-    chain_id: str,
+def _collect_entries(
     vals: ValidatorSet,
     commit: Commit,
     voting_power_needed: int,
     count_all: bool,
     lookup_by_address: bool,
-    backend: Optional[str] = None,
-) -> None:
-    """Shared engine for all three public variants.
-
-    count_all=True  -> verify every non-absent signature (consensus safety).
-    count_all=False -> stop as soon as tallied power exceeds the threshold
-                       (light-client fast path; remaining sigs unverified).
-    lookup_by_address -> trusting mode: commit indexes may not match the
-                       validator set; match signatures by address.
-    """
-    entries = []  # (commit_idx, validator, power_counts)
+):
+    """The entry-selection half of ``_verify_commit``: which (idx, val, cs)
+    triples get their signatures checked.  Shared with the pipelined
+    consumers (blocksync prefetch, light-client chain sync) so speculative
+    verification covers EXACTLY the entries the authoritative pass will
+    query.  Returns (entries, tallied) — tallied is only meaningful for
+    count_all=False, where collection stops at the power threshold."""
+    entries = []  # (commit_idx, validator, commit_sig)
     tallied = 0
     seen_addrs: set[bytes] = set()  # trusting mode: count each validator once
     for idx, cs in enumerate(commit.signatures):
@@ -116,8 +116,51 @@ def _verify_commit(
                 tallied += val.voting_power
             if tallied > voting_power_needed:
                 break
+    return entries, tallied
 
-    # Verify the collected signatures (batch seam).
+
+def _judge_entries(entries, bits) -> None:
+    """Turn per-entry accept bits into the verdict ``_verify_commit``
+    reports: first failed entry names the culprit index."""
+    for (idx, _, _), bit in zip(entries, bits):
+        if not bit:
+            raise InvalidSignatureError(idx)
+
+
+def _tally(entries, tallied: int, count_all: bool, voting_power_needed: int):
+    if count_all:
+        tallied = sum(
+            val.voting_power for _, val, cs in entries if cs.for_block()
+        )
+    if tallied <= voting_power_needed:
+        raise NotEnoughPowerError(tallied, voting_power_needed)
+
+
+def _verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    count_all: bool,
+    lookup_by_address: bool,
+    backend: Optional[str] = None,
+) -> None:
+    """Shared engine for all three public variants.
+
+    count_all=True  -> verify every non-absent signature (consensus safety).
+    count_all=False -> stop as soon as tallied power exceeds the threshold
+                       (light-client fast path; remaining sigs unverified).
+    lookup_by_address -> trusting mode: commit indexes may not match the
+                       validator set; match signatures by address.
+    """
+    t0 = time.perf_counter()
+    entries, tallied = _collect_entries(
+        vals, commit, voting_power_needed, count_all, lookup_by_address
+    )
+
+    # Verify the collected signatures (batch seam).  The batch verifiers
+    # pre-filter through the consensus-wide signature cache, so a commit
+    # whose votes were verified at gossip time ships zero device work.
     if entries:
         use_batch = _should_batch(vals, commit) and len(entries) >= 2
         if use_batch:
@@ -131,24 +174,86 @@ def _verify_commit(
                 bv.add(val.pub_key, sb, cs.signature)
             ok, bits = bv.verify()
             if not ok:
-                for (idx, _, _), bit in zip(entries, bits):
-                    if not bit:
-                        raise InvalidSignatureError(idx)
+                _judge_entries(entries, bits)
                 raise CommitVerificationError("batch verification failed")
         else:
             for idx, val, cs in entries:
-                if not val.pub_key.verify_signature(
-                    commit.vote_sign_bytes(chain_id, idx), cs.signature
+                if not sigcache.verify_with_cache(
+                    val.pub_key,
+                    commit.vote_sign_bytes(chain_id, idx),
+                    cs.signature,
                 ):
                     raise InvalidSignatureError(idx)
 
     # Tally voting power for the committed block.
-    if count_all:
-        tallied = sum(
-            val.voting_power for _, val, cs in entries if cs.for_block()
-        )
-    if tallied <= voting_power_needed:
-        raise NotEnoughPowerError(tallied, voting_power_needed)
+    _tally(entries, tallied, count_all, voting_power_needed)
+    dispatch_stats.record_verify_latency(time.perf_counter() - t0)
+
+
+@dataclass
+class PreparedCommit:
+    """The host half of a light commit verification, split out so pipelined
+    consumers (light-client chain sync, blocksync window prefetch) can
+    dispatch many commits' signature batches before judging any of them.
+    ``pubs``/``msgs``/``sigs`` align 1:1 with ``entries``."""
+
+    chain_id: str
+    vals: ValidatorSet
+    commit: Commit
+    voting_power_needed: int
+    tallied: int
+    count_all: bool = False
+    entries: list = field(default_factory=list)
+    pubs: list = field(default_factory=list)
+    msgs: list = field(default_factory=list)
+    sigs: list = field(default_factory=list)
+
+
+def prepare_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+    count_all: bool = False,
+) -> PreparedCommit:
+    """Phase 1 of ``verify_commit_light``: basic checks + entry collection +
+    sign-bytes construction.  Raises exactly what ``verify_commit_light``
+    would raise for a malformed commit; does NOT touch any signature.
+
+    ``count_all=True`` collects every non-absent entry (the superset the
+    full ``verify_commit`` queries) — blocksync prefetches with this so
+    BOTH the light frontier check and apply-time ``validate_block``'s full
+    re-verification resolve from cache."""
+    _verify_basic(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    entries, tallied = _collect_entries(vals, commit, needed, count_all, False)
+    msgs = commit.all_vote_sign_bytes(chain_id, [idx for idx, _, _ in entries])
+    return PreparedCommit(
+        chain_id=chain_id,
+        vals=vals,
+        commit=commit,
+        voting_power_needed=needed,
+        tallied=tallied,
+        count_all=count_all,
+        entries=entries,
+        pubs=[val.pub_key.bytes() for _, val, _ in entries],
+        msgs=list(msgs),
+        sigs=[cs.signature for _, _, cs in entries],
+    )
+
+
+def finish_commit_light(prepared: PreparedCommit, bits) -> None:
+    """Phase 2: judge the accept bits (aligned with ``prepared.entries``)
+    and tally power — same errors, same order, as the ``_verify_commit``
+    mode ``prepared`` was collected under."""
+    _judge_entries(prepared.entries, bits)
+    _tally(
+        prepared.entries,
+        prepared.tallied,
+        prepared.count_all,
+        prepared.voting_power_needed,
+    )
 
 
 def verify_commit(

@@ -73,8 +73,17 @@ class Vote:
         return None
 
     def verify(self, chain_id: str, pub_key) -> bool:
-        """Reference: types/vote.go:227 — single-signature path."""
-        return pub_key.verify_signature(self.sign_bytes(chain_id), self.signature)
+        """Reference: types/vote.go:227 — single-signature path.
+
+        Routed through the consensus-wide signature cache: a precommit
+        verified here at gossip time makes the commit built from it
+        near-free to re-verify at apply/blocksync time (the CommitSig
+        reconstructs byte-identical sign bytes from the same timestamp)."""
+        from cometbft_tpu.crypto import sigcache
+
+        return sigcache.verify_with_cache(
+            pub_key, self.sign_bytes(chain_id), self.signature
+        )
 
     def copy(self) -> "Vote":
         return replace(self)

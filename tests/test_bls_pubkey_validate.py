@@ -1,0 +1,100 @@
+"""Differential tests: native vs pure-Python BLS ``pubkey_validate`` on
+malformed and boundary encodings (ADVICE r5 #4).  The two implementations
+must agree bit-for-bit — a divergence would let a validator set that one
+node accepts be rejected by another, a consensus split."""
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as bls
+
+P = bls.P
+
+
+def _pure_validate(pub: bytes) -> bool:
+    """The pure-Python KeyValidate path (what ``pubkey_validate`` runs when
+    the native library is absent)."""
+    pt = bls.g1_deserialize(pub)
+    if pt is None or bls.E1.is_infinity(pt):
+        return False
+    return bls._g1_subgroup(pt)
+
+
+def _nonsubgroup_point() -> bytes:
+    """An on-curve point OUTSIDE the r-torsion subgroup (G1's cofactor is
+    ~2^125, so almost every curve point qualifies); 96-byte uncompressed."""
+    x = 0
+    while True:
+        y2 = (pow(x, 3, P) + 4) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P == y2 and not bls._g1_subgroup((x, y, 1)):
+            return x.to_bytes(48, "big") + y.to_bytes(48, "big")
+        x += 1
+
+
+def _vectors() -> dict:
+    sk = bls.gen_privkey_from_secret(b"pubkey-validate-diff")
+    good96 = bls.pubkey(sk)
+    pt = bls.g1_deserialize(good96)
+    x, y = bls.E1.affine(pt)
+    comp = bytearray(x.to_bytes(48, "big"))
+    comp[0] |= 0x80
+    if y > (P - 1) // 2:
+        comp[0] |= 0x20
+    off_curve_y = (int.from_bytes(good96[48:], "big") + 1) % P
+    return {
+        # well-formed
+        "uncompressed_valid": (good96, True),
+        "compressed_valid": (bytes(comp), True),
+        # infinity is rejected by KeyValidate in all encodings
+        "uncompressed_infinity": (b"\x40" + bytes(95), False),
+        "compressed_infinity": (bytes([0xC0]) + bytes(47), False),
+        "infinity_flag_with_garbage": (b"\x40\x01" + bytes(94), False),
+        # wrong flag bits
+        "uncompressed_with_compression_bit": (
+            bytes([good96[0] | 0x80]) + good96[1:],
+            False,
+        ),
+        # field-boundary coordinates: x >= p / y >= p must be rejected,
+        # not silently reduced
+        "x_ge_p_uncompressed": (P.to_bytes(48, "big") + good96[48:], False),
+        "y_ge_p_uncompressed": (good96[:48] + P.to_bytes(48, "big"), False),
+        "x_ge_p_compressed": (bytes([0x80 | 0x1F]) + b"\xff" * 47, False),
+        # on curve but not in the subgroup — the attack KeyValidate exists
+        # to stop (small-subgroup confinement)
+        "non_subgroup_point": (_nonsubgroup_point(), False),
+        "off_curve_point": (
+            good96[:48] + off_curve_y.to_bytes(48, "big"),
+            False,
+        ),
+        # lengths
+        "len_47": (bytes(47), False),
+        "len_95": (bytes(95), False),
+        "empty": (b"", False),
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_vectors()))
+def test_pure_verdicts(name):
+    pub, want = _vectors()[name]
+    assert _pure_validate(pub) is want, name
+
+
+@pytest.mark.parametrize("name", sorted(_vectors()))
+def test_native_matches_pure(name):
+    lib = bls._nat()
+    if lib is None:
+        pytest.skip("native BLS library not built")
+    pub, want = _vectors()[name]
+    got = lib.bls_pubkey_validate(pub, len(pub)) == 1
+    assert got is _pure_validate(pub), name
+    assert got is want, name
+
+
+def test_public_api_agrees_with_oracle(monkeypatch):
+    """``pubkey_validate`` (which auto-selects native) and the forced pure
+    path agree on every vector regardless of which backend is loaded."""
+    for name, (pub, want) in _vectors().items():
+        assert bls.pubkey_validate(pub) is want, name
+    monkeypatch.setattr(bls, "_nat", lambda: None)
+    for name, (pub, want) in _vectors().items():
+        assert bls.pubkey_validate(pub) is want, name

@@ -19,6 +19,10 @@ N_VALS = 4
 
 
 class TestRestartPerturbation:
+    @pytest.mark.slow  # live 4-node kill-restart testnet: wall-clock waits
+    # flake under full-suite load on the throttled 2-core CI host (passes
+    # in isolation); same category as the PR-1 slow-marked kill-restart
+    # testnets, stays in the full suite
     def test_validator_restart_and_catchup(self, tmp_path):
         """Stop one of four validators mid-chain; the other three keep
         committing; the restarted node replays its WAL, catches up and

@@ -1,0 +1,236 @@
+"""Consensus-wide signature cache (crypto/sigcache) — bounds, kill-switch,
+thread safety, and the gossip-then-commit loopback flow that motivates it
+(docs/verify-stream.md)."""
+
+import hashlib
+import threading
+
+import pytest
+
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto import sigcache
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    sigcache.reset_cache()
+    yield
+    sigcache.reset_cache()
+
+
+def _keypair(tag: bytes):
+    priv = Ed25519PrivKey.from_seed(hashlib.sha256(tag).digest())
+    return priv, priv.pub_key()
+
+
+class TestSigCache:
+    def test_put_get_roundtrip_and_stats(self):
+        c = sigcache.SigCache(capacity=8)
+        assert c.get(b"p", b"m", b"s") is None
+        c.put(b"p", b"m", b"s", True)
+        c.put(b"p", b"m2", b"s", False)
+        assert c.get(b"p", b"m", b"s") is True
+        assert c.get(b"p", b"m2", b"s") is False  # negative caching
+        st = c.stats()
+        assert st["hits"] == 2 and st["misses"] == 1 and st["size"] == 2
+        assert 0 < st["hit_rate"] < 1
+
+    def test_lru_bound_evicts_oldest(self):
+        c = sigcache.SigCache(capacity=3)
+        for i in range(4):
+            c.put(b"p%d" % i, b"m", b"s", True)
+        assert len(c) == 3
+        assert c.get(b"p0", b"m", b"s") is None  # evicted
+        assert c.get(b"p3", b"m", b"s") is True
+        # access refreshes recency: p1 survives the next insert, p2 doesn't
+        assert c.get(b"p1", b"m", b"s") is True
+        c.put(b"p4", b"m", b"s", True)
+        assert c.get(b"p2", b"m", b"s") is None
+        assert c.get(b"p1", b"m", b"s") is True
+
+    def test_key_is_unambiguous_across_field_boundaries(self):
+        c = sigcache.SigCache()
+        # same concatenation, different (pub, msg) split
+        c.put(b"ab", b"c", b"s", True)
+        assert c.get(b"a", b"bc", b"s") is None
+
+    def test_kill_switch_disables_lookup_and_insert(self, monkeypatch):
+        c = sigcache.SigCache()
+        c.put(b"p", b"m", b"s", True)
+        monkeypatch.setenv("COMETBFT_TPU_SIGCACHE", "0")
+        assert c.get(b"p", b"m", b"s") is None
+        c.put(b"p2", b"m", b"s", True)
+        monkeypatch.delenv("COMETBFT_TPU_SIGCACHE")
+        assert c.get(b"p", b"m", b"s") is True  # old entry intact
+        assert c.get(b"p2", b"m", b"s") is None  # disabled put dropped
+
+    def test_thread_safety_hammer(self):
+        c = sigcache.SigCache(capacity=64)
+        errors = []
+
+        def worker(t):
+            try:
+                for i in range(300):
+                    c.put(b"p%d" % (i % 97), b"m%d" % t, b"s", i % 2 == 0)
+                    c.get(b"p%d" % ((i + t) % 97), b"m%d" % t, b"s")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+        assert len(c) <= 64
+
+    def test_verify_with_cache_caches_both_verdicts(self):
+        priv, pub = _keypair(b"vwc")
+        msg = b"hello"
+        sig = priv.sign(msg)
+        bad = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        assert sigcache.verify_with_cache(pub, msg, sig) is True
+        assert sigcache.verify_with_cache(pub, msg, bad) is False
+        st = sigcache.get_cache().stats()
+        assert st["misses"] == 2 and st["size"] == 2
+        # second pass: pure hits
+        assert sigcache.verify_with_cache(pub, msg, sig) is True
+        assert sigcache.verify_with_cache(pub, msg, bad) is False
+        st = sigcache.get_cache().stats()
+        assert st["hits"] == 2
+
+
+class TestMetricsExposition:
+    def test_callback_gauges_scrape_without_jax(self):
+        """The verify-stream gauges read live counters at scrape time and a
+        scrape must never raise (or initialize an accelerator backend)."""
+        from cometbft_tpu.libs.metrics import NodeMetrics
+
+        priv, pub = _keypair(b"metrics")
+        sigcache.verify_with_cache(pub, b"m", priv.sign(b"m"))
+        sigcache.verify_with_cache(pub, b"m", priv.sign(b"m"))
+        page = NodeMetrics("testns").registry.expose()
+        assert "testns_crypto_sigcache_hits 1" in page
+        assert "testns_crypto_sigcache_misses 1" in page
+        assert "testns_crypto_sigcache_hit_rate 0.5" in page
+        assert "testns_crypto_verify_dispatches" in page
+        assert "testns_crypto_verify_batch_occupancy" in page
+
+
+class TestBatchVerifierIntegration:
+    def _entries(self, n, tamper=()):
+        privs = [_keypair(b"bv%d" % i)[0] for i in range(n)]
+        pubs = [p.pub_key() for p in privs]
+        msgs = [b"msg-%d" % i for i in range(n)]
+        sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+        for i in tamper:
+            sigs[i] = sigs[i][:32] + bytes([sigs[i][32] ^ 1]) + sigs[i][33:]
+        return pubs, msgs, sigs
+
+    def test_cpu_verifier_prefilters_hits(self):
+        pubs, msgs, sigs = self._entries(4, tamper=(2,))
+        bv = cbatch.CpuBatchVerifier()
+        for p, m, s in zip(pubs, msgs, sigs):
+            bv.add(p, m, s)
+        ok, bits = bv.verify()
+        assert not ok and bits == [True, True, False, True]
+        # second verifier over the same entries: zero backend work
+        bv2 = cbatch.CpuBatchVerifier()
+        calls = []
+        bv2._verify_pending = lambda *a: calls.append(a) or []
+        for p, m, s in zip(pubs, msgs, sigs):
+            bv2.add(p, m, s)
+        ok2, bits2 = bv2.verify()
+        assert (ok2, bits2) == (ok, bits)
+        assert not calls  # everything resolved from cache
+
+    def test_structural_garbage_never_reaches_backend(self):
+        pubs, msgs, sigs = self._entries(3)
+        bv = cbatch.CpuBatchVerifier()
+        bv.add(pubs[0], msgs[0], sigs[0])
+        bv.add(b"\x01" * 7, msgs[1], sigs[1])  # impossible pub length
+        bv.add(pubs[2], msgs[2], b"short")  # impossible sig length
+        shipped = []
+        real = bv._verify_pending
+        bv._verify_pending = lambda p, m, s: shipped.extend(p) or real(p, m, s)
+        ok, bits = bv.verify()
+        assert not ok and bits == [True, False, False]
+        # only the structurally-plausible entry occupied backend work
+        assert shipped == [pubs[0].bytes()]
+
+    def test_kill_switch_restores_uncached_behavior(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_SIGCACHE", "0")
+        pubs, msgs, sigs = self._entries(3, tamper=(1,))
+        for _ in range(2):  # no memoization across passes
+            bv = cbatch.CpuBatchVerifier()
+            shipped = []
+            real = bv._verify_pending
+            bv._verify_pending = (
+                lambda p, m, s: shipped.extend(p) or real(p, m, s)
+            )
+            for p, m, s in zip(pubs, msgs, sigs):
+                bv.add(p, m, s)
+            ok, bits = bv.verify()
+            assert not ok and bits == [True, False, True]
+            assert len(shipped) == 3  # every entry verified, every time
+        assert len(sigcache.get_cache()) == 0
+
+
+class TestLoopbackConsensusFlow:
+    def test_gossip_verified_votes_make_commit_verification_free(self):
+        """The motivating flow: precommits verified at gossip time
+        (vote_set.add_vote -> Vote.verify) make the commit assembled from
+        them verify with a 100% cache hit rate and zero backend work."""
+        from cometbft_tpu.types import validation
+        from cometbft_tpu.types.basic import (
+            PRECOMMIT_TYPE,
+            BlockID,
+            PartSetHeader,
+            Timestamp,
+        )
+        from cometbft_tpu.types.validator import Validator, ValidatorSet
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types.vote_set import VoteSet
+
+        chain_id = "sigcache-loopback"
+        privs = [_keypair(b"lb%d" % i)[0] for i in range(6)]
+        vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+        bid = BlockID(
+            hash=hashlib.sha256(b"blk").digest(),
+            part_set_header=PartSetHeader(1, hashlib.sha256(b"psh").digest()),
+        )
+        vs = VoteSet(chain_id, 7, 0, PRECOMMIT_TYPE, vals)
+        for p in privs:
+            addr = p.pub_key().address()
+            idx = vals.get_by_address(addr)[0]
+            v = Vote(
+                type_=PRECOMMIT_TYPE,
+                height=7,
+                round_=0,
+                block_id=bid,
+                timestamp=Timestamp(1_700_000_000, 0),
+                validator_address=addr,
+                validator_index=idx,
+            )
+            v.signature = p.sign(v.sign_bytes(chain_id))
+            vs.add_vote(v)  # gossip-time verification populates the cache
+        before = sigcache.get_cache().stats()
+        assert before["size"] == 6 and before["hits"] == 0
+
+        commit = vs.make_commit()
+        shipped = []
+        orig = cbatch.CpuBatchVerifier._verify_pending
+        try:
+            cbatch.CpuBatchVerifier._verify_pending = (
+                lambda self, p, m, s: shipped.extend(p) or orig(self, p, m, s)
+            )
+            validation.verify_commit(
+                chain_id, vals, bid, 7, commit, backend="cpu"
+            )
+        finally:
+            cbatch.CpuBatchVerifier._verify_pending = orig
+        after = sigcache.get_cache().stats()
+        assert not shipped  # zero backend verifications at commit time
+        assert after["hits"] - before["hits"] == 6
+        assert after["hit_rate"] > 0
