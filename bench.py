@@ -209,6 +209,91 @@ def run_catchup(emit, n_heights=4, sigs_per_commit=21, reps=3) -> dict:
     return rec
 
 
+def run_degraded(emit, n=128, reps=2) -> dict:
+    """Degraded-mode throughput (docs/backend-supervisor.md): the SAME
+    supervised ``verify_batch`` measured healthy (device tier) and with the
+    device faulted (circuit breaker open -> host ed25519_ref tier), then a
+    re-promotion probe after the fault clears.  Verdicts are asserted
+    bitwise-identical across tiers — the chain is only interesting because
+    degradation preserves them.  On chipless hosts the 'healthy' tier is
+    the XLA-CPU kernel build, so the ratio, not the absolute number, is
+    the story."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.ops import supervisor
+    from cometbft_tpu.ops import verify as ov
+
+    pubs, msgs, sigs = _make_batch(n)
+    backend_health.reset()
+    supervisor.clear_fault_injector()
+    # fake breaker clock: the degraded timing loop must not cross the real
+    # open->half-open backoff mid-sample (a granted probe would re-dispatch
+    # the faulted device inside a timed rep), and the recovery probe then
+    # needs no wall-clock sleep — advance the clock past the backoff instead
+    fake_now = [0.0]
+    backend_health.registry().set_clock(lambda: fake_now[0])
+
+    try:
+        want = _retry_unavailable(lambda: ov.verify_batch(pubs, msgs, sigs))
+        t_healthy = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            got = _retry_unavailable(lambda: ov.verify_batch(pubs, msgs, sigs))
+            t_healthy.append(time.perf_counter() - t0)
+            assert np.array_equal(got, want)
+
+        # fault the device until the breaker opens, then measure the host tier
+        supervisor.set_fault_injector(supervisor.FaultyBackend("raise"))
+        first = supervisor.device_chain()[0]
+        try:
+            threshold = backend_health.registry().breaker(first).threshold
+            for _ in range(threshold):
+                got = ov.verify_batch(pubs, msgs, sigs)
+                assert np.array_equal(got, want)  # degradation preserves verdicts
+            t_degraded = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                got = ov.verify_batch(pubs, msgs, sigs)
+                t_degraded.append(time.perf_counter() - t0)
+                assert np.array_equal(got, want)
+            snap = backend_health.snapshot()
+        finally:
+            supervisor.clear_fault_injector()
+
+        # recovery: advance the clock past the backoff; one probe re-promotes
+        repromoted = False
+        try:
+            fake_now[0] += backend_health.registry().breaker(first).backoff_max_s
+            got = ov.verify_batch(pubs, msgs, sigs)
+            assert np.array_equal(got, want)
+            repromoted = backend_health.snapshot()["repromotions"] >= 1
+        except AssertionError:
+            raise  # re-promotion changed verdicts: never mask that
+        except Exception:  # noqa: BLE001 — a missed probe is advisory
+            pass
+    finally:
+        backend_health.registry().set_clock(time.monotonic)
+        backend_health.reset()
+
+    rec = {
+        "metric": "degraded_mode_throughput",
+        "stage": "degraded",
+        "batch": n,
+        "healthy_sigs_per_s": round(n / min(t_healthy), 1),
+        "degraded_sigs_per_s": round(n / min(t_degraded), 1),
+        "degradation_ratio": round(min(t_degraded) / min(t_healthy), 3),
+        "demotions": snap["demotions"],
+        "breaker_opens": sum(
+            b["opens"] for b in snap["breakers"].values()
+        ),
+        "fallback_signatures": snap["fallback_signatures"],
+        "repromoted": repromoted,
+    }
+    emit(rec)
+    return rec
+
+
 def _loopback_cache_hit_rate() -> float:
     """Gossip-verify one round of precommits into a VoteSet, then re-verify
     the commit assembled from them (the apply-time LastCommit check) — the
@@ -320,6 +405,22 @@ def _worker_cpu() -> None:
             _emit(
                 _result_line(
                     "catchup-failed", 0.0, dict(partial=True, error=repr(e))
+                )
+            )
+    # degraded-mode stage: supervised chain healthy vs breaker-open host
+    # tier; advisory for the same reason as catchup
+    if os.environ.get("BENCH_DEGRADED", "1") != "0":
+        try:
+            run_degraded(
+                lambda rec: _emit(
+                    dict(rec, impl="xla", platform="cpu", partial=True)
+                ),
+                n=int(os.environ.get("BENCH_DEGRADED_BATCH", "128")),
+            )
+        except Exception as e:  # noqa: BLE001
+            _emit(
+                _result_line(
+                    "degraded-failed", 0.0, dict(partial=True, error=repr(e))
                 )
             )
     _emit(
@@ -877,6 +978,14 @@ def main() -> None:
         "verify_segments vs per-commit dispatches) on whatever platform "
         "JAX selects; BENCH_CATCHUP_HEIGHTS/_SIGS size the window",
     )
+    ap.add_argument(
+        "--degraded",
+        action="store_true",
+        help="run only the degraded-mode stage: supervised verify_batch "
+        "healthy (device tier) vs device faulted (breaker open -> host "
+        "ed25519_ref), plus the re-promotion probe; "
+        "BENCH_DEGRADED_BATCH sizes the batch",
+    )
     args = ap.parse_args()
     for k, v in _CACHE_ENV.items():
         os.environ.setdefault(k, v)
@@ -895,6 +1004,18 @@ def main() -> None:
             _emit,
             n_heights=int(os.environ.get("BENCH_CATCHUP_HEIGHTS", "4")),
             sigs_per_commit=int(os.environ.get("BENCH_CATCHUP_SIGS", "21")),
+        )
+    elif args.degraded:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _CACHE_ENV["JAX_COMPILATION_CACHE_DIR"],
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        run_degraded(
+            _emit, n=int(os.environ.get("BENCH_DEGRADED_BATCH", "128"))
         )
     elif args.worker:
         plat = os.environ.get("COMETBFT_TPU_JAX_PLATFORM")

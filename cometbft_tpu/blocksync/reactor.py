@@ -18,7 +18,6 @@ import time
 from typing import Optional
 
 from cometbft_tpu.blocksync.pool import BlockPool
-from cometbft_tpu.crypto import batch as cbatch
 from cometbft_tpu.crypto import sigcache
 from cometbft_tpu.libs import log as liblog
 from cometbft_tpu.libs import protoenc as pe
@@ -271,9 +270,11 @@ class BlocksyncReactor(Reactor):
         k = _window_k()
         if k < 2 or not sigcache.SigCache.enabled():
             return
-        if cbatch.default_backend() != "tpu":
-            # no trusted accelerator: the per-commit host library path is
-            # already optimal, and the XLA-CPU kernel would be a regression
+        if not validation.fused_verify_eligible([self.state.validators]):
+            # no trusted accelerator, every device breaker open (catchup
+            # then degrades to the authoritative per-commit host verify in
+            # _process_blocks; prefetch resumes once a half-open probe
+            # passes), or non-ed25519 validators — nothing to fuse
             return
         peek = getattr(self.pool, "peek_window", None)
         if peek is None:
@@ -281,13 +282,6 @@ class BlocksyncReactor(Reactor):
         window = peek(k)
         if len(window) < 3:
             return  # the two-block pipeline covers short runs
-        from cometbft_tpu.crypto import keys as ck
-
-        if not all(
-            getattr(v.pub_key, "type_", None) == ck.ED25519_KEY_TYPE
-            for v in self.state.validators.validators
-        ):
-            return  # fused kernel is ed25519-only
         to_fuse = []  # (fingerprint, height, prepared, bits, miss_indices)
         for i in range(len(window) - 1):
             h = window[i][0]

@@ -153,6 +153,12 @@ class _CollectingVerifier(BatchVerifier):
             self.pubs, self.msgs, self.sigs, self.PUB_SIZES, self.SIG_SIZES
         )
         if pending:
+            # Attribution contract: ``_verify_pending`` returns DEFINITIVE
+            # verdicts only.  An infrastructure failure must either raise
+            # (propagates — nothing is cached, the caller sees an error,
+            # not a False bit) or yield ``None`` for the affected entries
+            # (skipped by writeback so a possibly-valid signature is never
+            # negative-cached, then surfaced as a BackendError below).
             got = self._verify_pending(
                 [self.pubs[i] for i in pending],
                 [self.msgs[i] for i in pending],
@@ -160,6 +166,13 @@ class _CollectingVerifier(BatchVerifier):
             )
             sigcache.writeback(
                 self.pubs, self.msgs, self.sigs, bits, pending, got
+            )
+        if any(b is None for b in bits):
+            from cometbft_tpu.crypto import backend_health
+
+            raise backend_health.BackendError(
+                "batch backend produced no definitive verdict for some "
+                "entries (infrastructure failure, not a signature verdict)"
             )
         bits = [bool(b) for b in bits]
         return all(bits) and len(bits) > 0, bits
@@ -241,14 +254,42 @@ class Secp256k1BatchVerifier(_CollectingVerifier):
 
     def _verify_pending(self, pubs, msgs, sigs) -> list[bool]:
         if self._backend != "cpu" and _secp_device_ok():
-            try:
-                from cometbft_tpu.ops import secp_verify as sv
+            from cometbft_tpu.ops import supervisor
 
-                return [bool(b) for b in sv.verify_batch(pubs, msgs, sigs)]
-            except Exception:
-                logging.getLogger("cometbft_tpu.crypto").exception(
-                    "device secp verify failed; host fallback"
+            if not supervisor.enabled():
+                try:
+                    from cometbft_tpu.ops import secp_verify as sv
+
+                    return [bool(b) for b in sv.verify_batch(pubs, msgs, sigs)]
+                except Exception:
+                    logging.getLogger("cometbft_tpu.crypto").exception(
+                        "device secp verify failed; host fallback"
+                    )
+            else:
+                # supervised: the breaker decides whether the device is
+                # probed at all, the watchdog bounds a wedge, and a failure
+                # demotes (metrics + backoff) instead of silently retrying
+                # the dead device on every batch
+                from cometbft_tpu.crypto import backend_health
+
+                def _device():
+                    from cometbft_tpu.ops import secp_verify as sv
+
+                    return [bool(b) for b in sv.verify_batch(pubs, msgs, sigs)]
+
+                def _validate(bits):
+                    if len(bits) != len(pubs):
+                        raise backend_health.BackendOutputError(
+                            f"secp device returned {len(bits)} bits "
+                            f"for {len(pubs)} inputs"
+                        )
+
+                bits = supervisor.supervised_device_call(
+                    "secp_device", _device, _validate,
+                    fallback_units=len(pubs),
                 )
+                if bits is not None:
+                    return bits
         from cometbft_tpu.crypto.secp256k1 import Secp256k1PubKey
 
         bits = []
@@ -425,9 +466,23 @@ class BlsBatchVerifier(_CollectingVerifier):
         rs = [secrets.randbits(128) | 1 for _ in entries]
         r_bytes = [r.to_bytes(16, "big") for r in rs]
 
-        # rᵢ·pkᵢ — TPU MSM when trusted, else native scalar mul
+        # rᵢ·pkᵢ — TPU MSM when trusted, else native scalar mul.  With the
+        # bls_g1 breaker open, skip straight to the native library: routing
+        # through _scaled_pubkeys would land on the much slower pure-Python
+        # host fallback, and the native path is the better degraded tier.
+        use_device = self._backend != "cpu" and _bls_device_ok()
+        if use_device:
+            from cometbft_tpu.ops import supervisor
+
+            if supervisor.enabled():
+                from cometbft_tpu.crypto import backend_health
+
+                use_device = (
+                    backend_health.registry().breaker("bls_g1").state
+                    != backend_health.OPEN
+                )
         g1_parts = []
-        if self._backend != "cpu" and _bls_device_ok():
+        if use_device:
             pks = [bls.g1_deserialize(pubs[i]) for i in entries]
             for pt in self._scaled_pubkeys(pks, rs, self._backend):
                 g1_parts.append(bls.g1_serialize(bls.E1.neg_pt(pt)))
@@ -473,11 +528,17 @@ class BlsBatchVerifier(_CollectingVerifier):
     @staticmethod
     def _scaled_pubkeys(pks, rs, backend: Optional[str] = None):
         """[rᵢ·pkᵢ] as jacobian host points; TPU kernel when trusted and
-        not disabled by the backend kill-switch."""
+        not disabled by the backend kill-switch.  Supervised: the bls_g1
+        breaker skips a dead device, the watchdog bounds a wedge, and a
+        failure demotes to host arithmetic with the same metrics as the
+        ed25519 chain (scalar-mul output feeds a pairing CHECK, so a host
+        fallback changes cost, never verdicts)."""
         from cometbft_tpu.crypto import bls12381 as bls
 
         if backend != "cpu" and _bls_device_ok():
-            try:
+            from cometbft_tpu.ops import supervisor
+
+            def _device():
                 from cometbft_tpu.ops import bls_g1 as g1
 
                 affs = [bls.E1.affine(pk) for pk in pks]
@@ -486,10 +547,29 @@ class BlsBatchVerifier(_CollectingVerifier):
                     bls.E1.infinity() if a is None else (a[0], a[1], 1)
                     for a in out
                 ]
-            except Exception:
-                logging.getLogger("cometbft_tpu.crypto").exception(
-                    "TPU BLS G1 path raised - host fallback"
+
+            if not supervisor.enabled():
+                try:
+                    return _device()
+                except Exception:
+                    logging.getLogger("cometbft_tpu.crypto").exception(
+                        "TPU BLS G1 path raised - host fallback"
+                    )
+            else:
+                from cometbft_tpu.crypto import backend_health
+
+                def _validate(out):
+                    if len(out) != len(pks):
+                        raise backend_health.BackendOutputError(
+                            f"bls_g1 returned {len(out)} points for "
+                            f"{len(pks)} inputs"
+                        )
+
+                out = supervisor.supervised_device_call(
+                    "bls_g1", _device, _validate, fallback_units=len(pks)
                 )
+                if out is not None:
+                    return out
         return [bls.E1.mul_scalar(pk, r) for pk, r in zip(pks, rs)]
 
 

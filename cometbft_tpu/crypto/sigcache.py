@@ -75,7 +75,12 @@ class SigCache:
         counting: the stats then honestly read as all-miss-no-traffic)."""
         if not self.enabled():
             return None
-        k = _key(pub, msg, sig)
+        return self._get(_key(pub, msg, sig))
+
+    def _get(self, k: bytes) -> Optional[bool]:
+        """Lookup past the kill-switch check — batch callers
+        (``partition_misses``) hoist ``enabled()`` to once per batch; a
+        10k-signature commit must not pay an os.environ read per entry."""
         with self._lock:
             v = self._entries.get(k)
             if v is None:
@@ -88,7 +93,9 @@ class SigCache:
     def put(self, pub: bytes, msg: bytes, sig: bytes, ok: bool) -> None:
         if not self.enabled():
             return
-        k = _key(pub, msg, sig)
+        self._put(_key(pub, msg, sig), ok)
+
+    def _put(self, k: bytes, ok: bool) -> None:
         with self._lock:
             self._entries[k] = bool(ok)
             self._entries.move_to_end(k)
@@ -164,6 +171,7 @@ def partition_misses(
     feeds to ``writeback``.  Empty ``pub_sizes``/``sig_sizes`` disable
     that structural filter."""
     cache = get_cache()
+    enabled = cache.enabled()  # hoisted: one env read per batch, not per sig
     bits: list = [None] * len(pubs)
     miss: list = []
     for i, (p, m, s) in enumerate(zip(pubs, msgs, sigs)):
@@ -172,7 +180,7 @@ def partition_misses(
         ):
             bits[i] = False
             continue
-        hit = cache.get(p, m, s)
+        hit = cache._get(_key(p, m, s)) if enabled else None
         if hit is not None:
             bits[i] = hit
             continue
@@ -182,12 +190,22 @@ def partition_misses(
 
 def writeback(pubs, msgs, sigs, bits, miss_indices, results) -> None:
     """Resolve ``partition_misses``'s holes: record each fresh verdict in
-    ``bits`` and in the cache (``results`` aligns with ``miss_indices``)."""
+    ``bits`` and in the cache (``results`` aligns with ``miss_indices``).
+
+    Only DEFINITIVE verdicts are cached: a ``None`` result marks an entry
+    the backend could not judge (an infrastructure failure — see
+    docs/backend-supervisor.md).  Caching ``False`` for it would negative-
+    cache a possibly-valid signature forever, so the hole is left in
+    ``bits`` for the caller to surface as an error, never as a verdict."""
     cache = get_cache()
+    enabled = cache.enabled()  # hoisted: one env read per batch, not per sig
     for i, r in zip(miss_indices, results):
+        if r is None:
+            continue
         r = bool(r)
         bits[i] = r
-        cache.put(pubs[i], msgs[i], sigs[i], r)
+        if enabled:
+            cache._put(_key(pubs[i], msgs[i], sigs[i]), r)
 
 
 def verify_with_cache(pub_key, msg: bytes, sig: bytes) -> bool:

@@ -146,8 +146,6 @@ def verify_adjacent_chain(
 
     Falls back to the plain sequential loop when the accelerator batch
     backend is off or a validator set is not uniformly ed25519."""
-    from cometbft_tpu.crypto import batch as cbatch
-    from cometbft_tpu.crypto import keys as ck
     from cometbft_tpu.crypto import sigcache
     from cometbft_tpu.types import validation
 
@@ -162,7 +160,13 @@ def verify_adjacent_chain(
             )
             current = lb
 
-    if len(news) < 2 or cbatch.default_backend() != "tpu":
+    # shared eligibility gate (types/validation.fused_verify_eligible):
+    # trusted accelerator + live device tier (with every breaker open the
+    # sequential path host-verifies per header — same verdicts, no fused
+    # batches to build) + uniformly-ed25519 validator sets
+    if len(news) < 2 or not validation.fused_verify_eligible(
+        lb.validator_set for lb in news
+    ):
         return _sequential()
 
     # host pass: adjacency checks + entry collection for every header
@@ -182,12 +186,6 @@ def verify_adjacent_chain(
             )
         )
         current = lb
-    if not all(
-        getattr(v.pub_key, "type_", None) == ck.ED25519_KEY_TYPE
-        for p in prepared
-        for _, v, _ in p.entries
-    ):
-        return _sequential()  # fused kernel is ed25519-only
 
     # device pass: ship only cache misses, one overlapped batch per header
     per_header = []  # (prepared, bits-with-None-holes, miss_indices)

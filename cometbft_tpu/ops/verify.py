@@ -243,7 +243,17 @@ def prepare_batch(
 def verify_batch(
     pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
-    """Verify a batch; returns (n,) bool numpy array of per-signature results."""
+    """Verify a batch; returns (n,) bool numpy array of per-signature results.
+
+    Supervised by default (ops/supervisor): the dispatch runs under a
+    watchdog deadline and a device failure degrades down the verified
+    chain pallas -> xla -> host instead of raising — accept bits are
+    always definitive verdicts, never infrastructure errors in disguise.
+    ``COMETBFT_TPU_SUPERVISOR=0`` restores the raw dispatch below."""
+    from cometbft_tpu.ops import supervisor
+
+    if supervisor.enabled():
+        return supervisor.verify_supervised(pubs, msgs, sigs)
     arrays, n, structural = prepare_batch(pubs, msgs, sigs, _min_bucket())
     kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
     dispatch_stats.record_dispatch(arrays["s_ok"].shape[0], n)
@@ -265,7 +275,17 @@ def verify_batches_overlapped(
     pipeline, so the overlap is host-side only and the honest per-commit
     floor remains in bench.py's ``dispatch_floor_ms``).
 
-    Returns a list of (n,) bool arrays, one per input batch."""
+    Returns a list of (n,) bool arrays, one per input batch.
+
+    Supervised by default: each dispatch and each fetch runs under the
+    watchdog, a mid-window device failure re-runs the affected batch on
+    the next tier down (the rest of the window skips the dead device),
+    and with every device breaker open the whole window resolves on the
+    host — degraded, never aborted."""
+    from cometbft_tpu.ops import supervisor
+
+    if supervisor.enabled():
+        return supervisor.verify_batches_overlapped_supervised(work)
     kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
     min_b = _min_bucket()
     inflight = []  # (device result, n, structural)

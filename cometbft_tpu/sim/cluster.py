@@ -95,6 +95,11 @@ class SimCluster:
         )
         self.trace: list[str] = []
         self.events_fired = 0
+        # Which node's work is currently executing (set while draining a
+        # node's queue) — backend-fault scenarios use it to scope injected
+        # device failures to a victim subset; None = cluster-level work
+        # (invariant checker, scripted actions).
+        self.active_node: Optional[int] = None
         self._dbs: list = [None] * n_vals  # MemKV survives crash-restart
         self.nodes: list[Optional[NodeHandle]] = [
             self._build(i) for i in range(n_vals)
@@ -187,8 +192,12 @@ class SimCluster:
             progress = False
             for node in self.nodes:
                 if node is not None and node.cs.is_running:
-                    if node.cs.process_pending():
-                        progress = True
+                    self.active_node = node.index
+                    try:
+                        if node.cs.process_pending():
+                            progress = True
+                    finally:
+                        self.active_node = None
 
     def step(self) -> bool:
         """Fire one scheduled event + drain + check invariants."""

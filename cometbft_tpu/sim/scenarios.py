@@ -13,16 +13,35 @@ Built-ins:
   * ``crash-restart``      — kill f nodes mid-run, restart from their stores
   * ``asymmetric-loss``    — 30% one-directional loss on node0's egress
   * ``message-storm``      — duplicates + aggressive reordering on all links
+  * ``backend-brownout``   — device crypto backend raises on f+1 nodes
+    mid-run (t=5..10); supervisor must degrade to host, keep agreement,
+    and re-promote after restore
+  * ``backend-wedge``      — device dispatches hang past the watchdog
+  * ``backend-flap``       — device fails in bursts; breaker must cycle
+    open -> half-open -> closed with exponential backoff
+
+The backend-* scenarios force the supervised device verify path
+(``COMETBFT_TPU_CRYPTO_BACKEND=tpu`` — verdict-equal on CPU hosts via the
+XLA kernel), disable the sigcache so every commit verification really
+dispatches, pin the breaker clock to the cluster's ``VirtualClock`` (so
+backoff windows are deterministic), and install a ``FaultyBackend``
+injector at scripted virtual times.  One process hosts every sim node, so
+the circuit breaker registry is shared: a victim node's failures demote
+the device for the whole cluster — conservative over-degradation (verdicts
+never change; per-node registries are e2e territory).
 """
 
 from __future__ import annotations
 
+import os
 import shutil
 import tempfile
+import time as _wall
 from dataclasses import dataclass, field, replace
 from pathlib import Path
 from typing import Callable, Optional
 
+from cometbft_tpu.ops import supervisor
 from cometbft_tpu.sim.cluster import SimCluster
 
 
@@ -42,6 +61,12 @@ class Scenario:
     max_time: float = 120.0
     link_overrides: dict = field(default_factory=dict)
     actions: Callable[[Scenario], list[Action]] = lambda _s: []
+    # setup runs after the cluster is built but before it starts; teardown
+    # runs in run_scenario's finally (process-global state the scenario
+    # touched — env knobs, fault injectors, breaker clocks — MUST be
+    # restored there)
+    setup: Optional[Callable[[SimCluster], None]] = None
+    teardown: Optional[Callable[[SimCluster], None]] = None
 
 
 @dataclass
@@ -58,10 +83,13 @@ class ScenarioResult:
     violations: list[str]
     trace: list[str]
     cluster: Optional[SimCluster] = None
+    # backend supervisor counters captured at end-of-run (backend-* fault
+    # scenarios only): demotions, repromotions, watchdog_fires, breakers…
+    backend: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
-        return {
+        row = {
             "scenario": self.scenario,
             "seed": self.seed,
             "n_vals": self.n_vals,
@@ -74,6 +102,9 @@ class ScenarioResult:
             "invariants_ok": not self.violations,
             "violations": self.violations,
         }
+        if self.backend:
+            row["backend"] = self.backend
+        return row
 
 
 def _proposer_index(cluster: SimCluster) -> int:
@@ -128,6 +159,177 @@ def _asymmetric_loss(s: Scenario) -> list[Action]:
             c.net.set_link(0, dst, drop_rate=0.3)  # egress only; ingress clean
 
     return [Action(0.0, "30% loss on node0 egress", degrade)]
+
+
+# -- backend fault scenarios -------------------------------------------------
+
+_BACKEND_ENV_KNOBS = (
+    "COMETBFT_TPU_CRYPTO_BACKEND",
+    "COMETBFT_TPU_SIGCACHE",
+    "COMETBFT_TPU_DISPATCH_TIMEOUT_MS",
+    "COMETBFT_TPU_BREAKER_THRESHOLD",
+    "COMETBFT_TPU_SUPERVISOR_BISECT",
+)
+
+
+def _sim_device_runner(backend, pubs, msgs, sigs, lanes):
+    """Host-backed stand-in for the device tier (supervisor device-runner
+    seam): verdict-identical to the kernel by construction — it IS the
+    kernel's differential oracle — but without the ~1.7 s-per-dispatch
+    wall cost a real XLA dispatch pays on the throttled CI host.  The
+    breaker/watchdog/injector machinery under test runs unchanged above
+    this seam; COMETBFT_TPU_SIM_REAL_DEVICE=1 restores the real kernel."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519_ref as ref
+
+    out = np.zeros(lanes, dtype=bool)
+    out[: len(pubs)] = [
+        ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+    ]
+    return out
+
+
+def _backend_faults_setup(extra_env: Optional[dict] = None):
+    """Build a Scenario.setup that forces the supervised device verify
+    path and pins breaker backoff to the cluster's virtual clock.  The
+    matching teardown restores every piece of process-global state."""
+
+    def setup(cluster: SimCluster) -> None:
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.crypto import batch as cbatch
+
+        saved_env = {k: os.environ.get(k) for k in _BACKEND_ENV_KNOBS}
+        cluster._backend_saved = (saved_env, cbatch._DEFAULT_BACKEND)
+        # device path even on CPU hosts: the XLA kernel is verdict-equal to
+        # the host reference, and that equality is what degradation relies on
+        os.environ["COMETBFT_TPU_CRYPTO_BACKEND"] = "tpu"
+        cbatch.set_default_backend("tpu")
+        # without this every apply-time commit would resolve from verdicts
+        # cached at gossip time and the fault window would exercise nothing
+        os.environ["COMETBFT_TPU_SIGCACHE"] = "0"
+        supervisor.clear_fault_injector()
+        if os.environ.get("COMETBFT_TPU_SIM_REAL_DEVICE") == "1":
+            # slow lane: real XLA dispatches.  Warm the kernel BEFORE the
+            # scenario's env overrides apply — the first dispatch may
+            # include a compile, which a scenario-shortened watchdog (e.g.
+            # backend-wedge's 80 ms) would otherwise mistake for a wedge
+            # and open the breaker at t=0.
+            from cometbft_tpu.crypto import ed25519_ref as ref
+            from cometbft_tpu.ops import verify as ov
+
+            seed = b"\x07" * 32
+            ov.verify_batch(
+                [ref.pubkey_from_seed(seed)],
+                [b"warmup"],
+                [ref.sign(seed, b"warmup")],
+            )
+        else:
+            supervisor.set_device_runner(_sim_device_runner)
+        for k, v in (extra_env or {}).items():
+            os.environ[k] = v
+        # reset AFTER the env overrides so scenario breakers pick up the
+        # overridden threshold (breaker knobs are read at creation), and
+        # after the warmup so its breaker traffic doesn't leak into stats
+        backend_health.reset()
+        backend_health.registry().set_clock(cluster.clock.now)
+
+    return setup
+
+
+def _backend_faults_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.crypto import batch as cbatch
+
+    supervisor.clear_fault_injector()
+    supervisor.clear_device_runner()
+    saved_env, saved_backend = getattr(cluster, "_backend_saved", ({}, None))
+    for k in _BACKEND_ENV_KNOBS:
+        v = saved_env.get(k)
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    cbatch.set_default_backend(saved_backend)
+    backend_health.registry().set_clock(_wall.monotonic)
+    backend_health.reset()
+
+
+def _victims(n_vals: int) -> list[int]:
+    """f+1 nodes lose their device: more than the Byzantine tolerance —
+    agreement must survive anyway because degradation is verdict-
+    preserving, not because the victims are outvoted."""
+    return list(range(_f(n_vals) + 1))
+
+
+def _install_victim_injector(cluster: SimCluster, shim) -> None:
+    victims = set(_victims(cluster.n_vals))
+
+    def inject(backend, pubs, msgs, sigs):
+        if cluster.active_node not in victims:
+            return None  # healthy node (or cluster-level work, e.g. checker)
+        return shim(backend, pubs, msgs, sigs)
+
+    supervisor.set_fault_injector(inject)
+
+
+def _backend_brownout(s: Scenario) -> list[Action]:
+    def down(c: SimCluster) -> None:
+        c._log(
+            "scenario: device backend down on nodes %s" % _victims(c.n_vals)
+        )
+        _install_victim_injector(c, supervisor.FaultyBackend("raise"))
+
+    def up(c: SimCluster) -> None:
+        c._log("scenario: device backend restored")
+        supervisor.clear_fault_injector()
+
+    return [
+        Action(5.0, "device backend brownout (f+1 nodes)", down),
+        Action(10.0, "restore device backend", up),
+    ]
+
+
+def _backend_wedge(s: Scenario) -> list[Action]:
+    def wedge(c: SimCluster) -> None:
+        c._log(
+            "scenario: device dispatches wedge on nodes %s" % _victims(c.n_vals)
+        )
+        # hang_s is real (wall) time: it must exceed the scenario's 80 ms
+        # watchdog but stay small so abandoned workers drain quickly
+        _install_victim_injector(
+            c, supervisor.FaultyBackend("hang", hang_s=0.25)
+        )
+
+    def up(c: SimCluster) -> None:
+        c._log("scenario: device backend unwedged")
+        supervisor.clear_fault_injector()
+
+    return [
+        Action(4.0, "device backend wedge (f+1 nodes)", wedge),
+        Action(9.0, "unwedge device backend", up),
+    ]
+
+
+def _backend_flap(s: Scenario) -> list[Action]:
+    def flap(c: SimCluster) -> None:
+        c._log("scenario: device backend flapping (all nodes)")
+        # bursts of fail_n=4 failures (past the breaker threshold of 3)
+        # followed by pass_n=2 clean dispatches: the breaker must open,
+        # probe half-open on the virtual-clock backoff, re-promote on a
+        # pass-phase probe, and re-open on the next burst
+        supervisor.set_fault_injector(
+            supervisor.FaultyBackend("flap", fail_n=4, pass_n=2)
+        )
+
+    def up(c: SimCluster) -> None:
+        c._log("scenario: device backend stable")
+        supervisor.clear_fault_injector()
+
+    return [
+        Action(3.0, "device backend flap", flap),
+        Action(14.0, "stabilize device backend", up),
+    ]
 
 
 def _message_storm(s: Scenario) -> list[Action]:
@@ -191,6 +393,53 @@ SCENARIOS: dict[str, Scenario] = {
             max_time=240.0,
             actions=_message_storm,
         ),
+        Scenario(
+            "backend-brownout",
+            "device crypto backend raises on every dispatch on f+1 nodes "
+            "from t=5 to t=10; supervisor degrades to host verify, keeps "
+            "agreement, re-promotes after restore.  Breaker threshold 1: "
+            "the registry is cluster-shared in-process, so healthy nodes' "
+            "successes would otherwise keep resetting the victims' "
+            "consecutive-failure count",
+            target_height=14,
+            max_time=180.0,
+            actions=_backend_brownout,
+            setup=_backend_faults_setup(
+                {"COMETBFT_TPU_BREAKER_THRESHOLD": "1"}
+            ),
+            teardown=_backend_faults_teardown,
+        ),
+        Scenario(
+            "backend-wedge",
+            "device dispatches hang past the watchdog deadline on f+1 "
+            "nodes from t=4 to t=9; the watchdog abandons them and the "
+            "chain degrades without blocking consensus",
+            target_height=14,
+            max_time=180.0,
+            actions=_backend_wedge,
+            setup=_backend_faults_setup(
+                {
+                    "COMETBFT_TPU_DISPATCH_TIMEOUT_MS": "80",
+                    "COMETBFT_TPU_BREAKER_THRESHOLD": "1",
+                }
+            ),
+            teardown=_backend_faults_teardown,
+        ),
+        Scenario(
+            "backend-flap",
+            "device backend fails in bursts of 4 with 2 clean dispatches "
+            "between (t=3..14): breaker cycles open/half-open/closed on "
+            "the virtual-clock backoff schedule.  Bisection is disabled — "
+            "a flapping backend would let the bisector spuriously 'solve' "
+            "each burst and mask the breaker cycling under test",
+            target_height=12,
+            max_time=240.0,
+            actions=_backend_flap,
+            setup=_backend_faults_setup(
+                {"COMETBFT_TPU_SUPERVISOR_BISECT": "0"}
+            ),
+            teardown=_backend_faults_teardown,
+        ),
     ]
 }
 
@@ -207,7 +456,8 @@ def run_scenario(
 ) -> ScenarioResult:
     """Build a cluster, script the scenario's actions onto its virtual
     clock, and drive it to the target height (or the time budget)."""
-    scenario = SCENARIOS[name]
+    scenario = SCENARIOS.get(name) or SCENARIOS[name.replace("_", "-")]
+    name = scenario.name
     # overrides flow into the scenario the action generators see, so e.g.
     # _partition_minority picks its victims from the real cluster size
     scenario = replace(
@@ -230,11 +480,34 @@ def run_scenario(
             lambda a=action: a.fn(cluster),
             label=f"scenario {action.name}",
         )
+    backend_stats: dict = {}
     try:
+        if scenario.setup is not None:
+            scenario.setup(cluster)
         reached = cluster.run(
             until_height=scenario.target_height, max_time=scenario.max_time
         )
+        if scenario.setup is not None:
+            # capture BEFORE teardown resets the registry
+            from cometbft_tpu.crypto import backend_health
+
+            snap = backend_health.snapshot()
+            backend_stats = {
+                "demotions": snap["demotions"],
+                "repromotions": snap["repromotions"],
+                "watchdog_fires": snap["watchdog_fires"],
+                "fallback_signatures": snap["fallback_signatures"],
+                "quarantined": snap["quarantined"],
+                "breaker_opens": sum(
+                    b["opens"] for b in snap["breakers"].values()
+                ),
+                "breakers": {
+                    n: b["state"] for n, b in snap["breakers"].items()
+                },
+            }
     finally:
+        if scenario.teardown is not None:
+            scenario.teardown(cluster)
         cluster.stop()
         if created_root and not keep_cluster:
             shutil.rmtree(root, ignore_errors=True)
@@ -251,4 +524,5 @@ def run_scenario(
         violations=[str(v) for v in cluster.checker.violations],
         trace=cluster.trace,
         cluster=cluster if keep_cluster else None,
+        backend=backend_stats,
     )

@@ -280,12 +280,14 @@ class BlockExecutor:
                 raise InvalidBlockError(
                     "last commit size != last validator set size"
                 )
-            import time as _time
+            before = t0 = None
+            if self.logger is not None:
+                import time as _time
 
-            from cometbft_tpu.crypto import sigcache
+                from cometbft_tpu.crypto import sigcache
 
-            before = sigcache.get_cache().stats()
-            t0 = _time.perf_counter()
+                before = sigcache.get_cache().stats()
+                t0 = _time.perf_counter()
             validation.verify_commit(
                 state.chain_id,
                 state.last_validators,

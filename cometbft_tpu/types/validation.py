@@ -243,6 +243,32 @@ def prepare_commit_light(
     )
 
 
+def fused_verify_eligible(validator_sets=()) -> bool:
+    """THE eligibility gate for speculative fused verification, shared by
+    the blocksync window prefetch and the light-client chain sync so the
+    clauses cannot diverge: a trusted accelerator backend must be selected
+    (a CPU-backend node's host library path has no dispatch floor to
+    amortize), the supervisor must have a live device tier (with every
+    breaker open, catchup degrades to per-commit host verify instead of
+    speculating — see docs/backend-supervisor.md), and every supplied
+    validator set must be uniformly ed25519 (the fused kernel's key type)."""
+    from cometbft_tpu.crypto import batch as cbatch
+    from cometbft_tpu.crypto import keys as ck
+    from cometbft_tpu.ops import supervisor
+
+    if cbatch.default_backend() != "tpu":
+        return False
+    if supervisor.enabled() and supervisor.active_backend() is None:
+        return False
+    for vals in validator_sets:
+        if not all(
+            getattr(v.pub_key, "type_", None) == ck.ED25519_KEY_TYPE
+            for v in vals.validators
+        ):
+            return False
+    return True
+
+
 def finish_commit_light(prepared: PreparedCommit, bits) -> None:
     """Phase 2: judge the accept bits (aligned with ``prepared.entries``)
     and tally power — same errors, same order, as the ``_verify_commit``

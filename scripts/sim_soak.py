@@ -62,14 +62,26 @@ def main() -> int:
             row = res.summary()
             rows.append(row)
             ok = row["reached"] and row["invariants_ok"]
+            backend = row.get("backend") or {}
+            extra = ""
+            if backend:
+                # backend-* scenarios: breaker activity is part of the
+                # verdict a reviewer wants at a glance
+                extra = " demote=%d repromote=%d watchdog=%d opens=%d" % (
+                    backend.get("demotions", 0),
+                    backend.get("repromotions", 0),
+                    backend.get("watchdog_fires", 0),
+                    backend.get("breaker_opens", 0),
+                )
             print(
-                "%-20s seed=%-4d %s heights=%s events=%d"
+                "%-20s seed=%-4d %s heights=%s events=%d%s"
                 % (
                     name,
                     seed,
                     "ok  " if ok else "FAIL",
                     row["heights"],
                     row["events"],
+                    extra,
                 )
             )
             if not ok:

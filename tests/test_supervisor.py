@@ -1,0 +1,543 @@
+"""Backend supervisor (ISSUE 4): watchdog, circuit breaker, and the
+verified degradation chain around the verify hot path.
+
+The load-bearing guarantee, pinned by the differential tests: an
+INFRASTRUCTURE failure (raise / hang past the watchdog / malformed output
+/ flapping device) never changes an accept bit — under every fault mode
+the supervised ``verify_batch`` is bitwise-equal to the pure-host
+``ed25519_ref.verify_zip215`` oracle, and no exception escapes to the
+caller.
+
+Most tests install a host-backed device runner (the supervisor's
+device-runner seam) so a "device dispatch" costs ~1 ms instead of the
+~1.7 s a real XLA-CPU dispatch costs on this throttled host; everything
+under test (watchdog, breaker, injector, bisection) sits above that seam.
+Kernel-vs-oracle equivalence itself is tests/test_ed25519_jax.py's job.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import backend_health as bh
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import supervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean_supervisor_state():
+    bh.reset()
+    supervisor.clear_fault_injector()
+    supervisor.clear_device_runner()
+    yield
+    bh.reset()
+    supervisor.clear_fault_injector()
+    supervisor.clear_device_runner()
+
+
+class _CountingRunner:
+    """Host-backed device runner that counts invocations."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, backend, pubs, msgs, sigs, lanes):
+        self.calls += 1
+        out = np.zeros(lanes, dtype=bool)
+        out[: len(pubs)] = [
+            ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)
+        ]
+        return out
+
+
+def _mixed_batch(rng: np.random.Generator, n: int):
+    """Randomized valid/invalid mix: tampered sigs, truncated sigs, wrong
+    pub lengths, swapped messages — every failure class the structural
+    filter and the kernel distinguish."""
+    pubs, msgs, sigs = [], [], []
+    for i in range(n):
+        seed = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        pub = ref.pubkey_from_seed(seed)
+        msg = b"msg-%d" % i
+        sig = ref.sign(seed, msg)
+        kind = int(rng.integers(0, 6))
+        if kind == 1:  # tampered signature
+            sig = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        elif kind == 2:  # truncated signature
+            sig = sig[:40]
+        elif kind == 3:  # wrong pub length
+            pub = pub[:31]
+        elif kind == 4:  # message swap
+            msg = b"other-%d" % i
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(sig)
+    return pubs, msgs, sigs
+
+
+def _oracle(pubs, msgs, sigs):
+    return [ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)]
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- circuit breaker state machine ------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _mk(self, threshold=3, backoff=1.0, cap=8.0):
+        clk = _FakeClock()
+        br = bh.CircuitBreaker(
+            "t", threshold=threshold, backoff_s=backoff,
+            backoff_max_s=cap, clock=clk,
+        )
+        return br, clk
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        br, _ = self._mk(threshold=3)
+        for _ in range(2):
+            br.record_failure(RuntimeError("x"))
+            assert br.state == bh.CLOSED
+            assert br.allow()
+        br.record_failure(RuntimeError("x"))
+        assert br.state == bh.OPEN
+        assert not br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br, _ = self._mk(threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == bh.CLOSED  # never saw 2 consecutive
+
+    def test_half_open_probe_after_backoff_then_close(self):
+        br, clk = self._mk(threshold=1, backoff=1.0)
+        br.record_failure()
+        assert not br.allow()
+        clk.advance(0.99)
+        assert not br.allow()
+        clk.advance(0.02)
+        assert br.state == bh.HALF_OPEN
+        assert br.allow()  # the probe
+        assert not br.allow()  # only ONE probe per window
+        br.record_success()
+        assert br.state == bh.CLOSED
+        assert br.stats()["repromotions"] == 1
+        # re-promotion resets the backoff schedule
+        assert br.stats()["backoff_s"] == 1.0
+
+    def test_failed_probe_reopens_with_doubled_backoff(self):
+        br, clk = self._mk(threshold=1, backoff=1.0, cap=3.0)
+        br.record_failure()  # open; next window 2.0
+        clk.advance(1.01)
+        assert br.allow()
+        br.record_failure()  # probe failed; open for 2.0, next window 3.0 (cap)
+        assert not br.allow()
+        clk.advance(1.5)
+        assert not br.allow()  # 2.0 not yet elapsed
+        clk.advance(0.6)
+        assert br.allow()
+        br.record_failure()  # open for 3.0 (capped), stays 3.0
+        assert br.stats()["backoff_s"] == 3.0
+        clk.advance(2.9)
+        assert not br.allow()
+        clk.advance(0.2)
+        assert br.allow()
+        br.record_success()
+        assert br.state == bh.CLOSED
+
+    def test_deterministic_under_fake_clock(self):
+        def run():
+            br, clk = self._mk(threshold=2, backoff=0.5, cap=4.0)
+            log = []
+            for step in range(40):
+                if br.allow():
+                    (br.record_failure if step % 3 else br.record_success)()
+                log.append((br.state, round(br.stats()["backoff_s"], 3)))
+                clk.advance(0.3)
+            return log
+
+        assert run() == run()
+
+
+# -- watchdog ----------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_passthrough_value_and_exception(self):
+        assert supervisor.watchdog_call(lambda: 42, timeout_s=5.0) == 42
+        with pytest.raises(ValueError):
+            supervisor.watchdog_call(
+                lambda: (_ for _ in ()).throw(ValueError("boom")),
+                timeout_s=5.0,
+            )
+
+    def test_timeout_fires_and_worker_recovers(self):
+        release = threading.Event()
+
+        def wedge():
+            release.wait(5.0)
+            return "late"
+
+        t0 = time.monotonic()
+        with pytest.raises(bh.DispatchTimeoutError):
+            supervisor.watchdog_call(wedge, timeout_s=0.05, backend="xla")
+        assert time.monotonic() - t0 < 2.0  # caller not blocked for 5 s
+        assert bh.snapshot()["watchdog_fires"] == 1
+        release.set()  # unwedge the abandoned worker
+        # a fresh worker serves the next call
+        assert supervisor.watchdog_call(lambda: "ok", timeout_s=1.0) == "ok"
+
+    def test_zero_timeout_runs_inline(self):
+        tid = supervisor.watchdog_call(
+            lambda: threading.get_ident(), timeout_s=0
+        )
+        assert tid == threading.get_ident()
+
+
+# -- differential: fault modes vs host oracle --------------------------------
+
+
+class TestFaultDifferential:
+    """For every injected fault mode the final accept bits are bitwise
+    equal to the pure-host oracle and no exception reaches the caller —
+    the acceptance criterion of ISSUE 4."""
+
+    @pytest.mark.parametrize("mode", ["raise", "hang", "wrong_shape", "flap"])
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_verify_batch_bitwise_oracle(self, mode, seed, monkeypatch):
+        from cometbft_tpu.ops import verify as ov
+
+        if mode == "hang":
+            monkeypatch.setenv("COMETBFT_TPU_DISPATCH_TIMEOUT_MS", "60")
+        rng = np.random.default_rng(seed)
+        pubs, msgs, sigs = _mixed_batch(rng, 12)
+        runner = _CountingRunner()
+        supervisor.set_device_runner(runner)
+        shim = supervisor.FaultyBackend(
+            mode, hang_s=0.25, fail_n=2, pass_n=1
+        )
+        supervisor.set_fault_injector(shim)
+        for _ in range(4):  # several batches: breaker transitions included
+            got = ov.verify_batch(pubs, msgs, sigs)
+            assert list(got) == _oracle(pubs, msgs, sigs)
+
+    def test_verify_segments_under_fault(self):
+        from cometbft_tpu.ops import verify as ov
+
+        rng = np.random.default_rng(2)
+        work = [_mixed_batch(rng, k) for k in (3, 5, 2)]
+        supervisor.set_device_runner(_CountingRunner())
+        supervisor.set_fault_injector(supervisor.FaultyBackend("raise"))
+        outs = ov.verify_segments(work)
+        assert [list(o) for o in outs] == [_oracle(*w) for w in work]
+
+    def test_overlapped_under_fault_and_degraded(self):
+        from cometbft_tpu.ops import verify as ov
+
+        rng = np.random.default_rng(3)
+        work = [_mixed_batch(rng, k) for k in (4, 3)]
+        runner = _CountingRunner()
+        supervisor.set_device_runner(runner)
+        supervisor.set_fault_injector(supervisor.FaultyBackend("raise"))
+        outs = ov.verify_batches_overlapped(work)
+        assert [list(o) for o in outs] == [_oracle(*w) for w in work]
+        # pre-open every device breaker: the window must resolve on host
+        # with zero device calls
+        for b in supervisor.device_chain():
+            br = bh.registry().breaker(b)
+            for _ in range(br.threshold):
+                br.record_failure(RuntimeError("down"))
+        calls = runner.calls
+        outs = ov.verify_batches_overlapped(work)
+        assert [list(o) for o in outs] == [_oracle(*w) for w in work]
+        assert runner.calls == calls  # no device dispatch while open
+
+    def test_no_invalid_signature_error_from_infra(self, monkeypatch):
+        """A commit whose signatures are all VALID must verify even while
+        the device backend is down — the infra failure must not surface
+        as InvalidSignatureError (misattribution) or any other error."""
+        monkeypatch.setenv("COMETBFT_TPU_SIGCACHE", "0")
+        from cometbft_tpu.crypto import batch as cbatch
+
+        supervisor.set_device_runner(_CountingRunner())
+        supervisor.set_fault_injector(supervisor.FaultyBackend("raise"))
+        bv = cbatch.TpuBatchVerifier()
+        for i in range(4):
+            seed = bytes([i + 1]) * 32
+            msg = b"commit-vote-%d" % i
+            bv.add(ref.pubkey_from_seed(seed), msg, ref.sign(seed, msg))
+        ok, bits = bv.verify()
+        assert ok and all(bits)
+
+
+# -- bisection / quarantine --------------------------------------------------
+
+
+class TestBisectQuarantine:
+    def _poison_setup(self, n=7):
+        rng = np.random.default_rng(9)
+        seeds = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(n)]
+        pubs = [ref.pubkey_from_seed(s) for s in seeds]
+        msgs = [b"m%d" % i for i in range(n)]
+        sigs = [ref.sign(s, m) for s, m in zip(seeds, msgs)]
+        poison = pubs[3]  # a VALID signature whose presence kills the kernel
+
+        def inject(backend, p, m, s):
+            if poison in p:
+                raise RuntimeError("poisoned input kills kernel")
+            return None
+
+        return pubs, msgs, sigs, inject
+
+    def test_single_poisoned_input_quarantined(self):
+        from cometbft_tpu.ops import verify as ov
+
+        pubs, msgs, sigs, inject = self._poison_setup()
+        supervisor.set_device_runner(_CountingRunner())
+        supervisor.set_fault_injector(inject)
+        got = ov.verify_batch(pubs, msgs, sigs)
+        # the poisoned input is VALID: quarantine verdicts it True via the
+        # host oracle instead of blaming the signer for the crash
+        assert list(got) == _oracle(pubs, msgs, sigs) == [True] * 7
+        snap = bh.snapshot()
+        assert snap["quarantined"] == 1
+        assert snap["demotions"] == 0  # backend stayed in service
+        assert snap["breakers"]["xla"]["state"] == bh.CLOSED
+
+    def test_systematic_failure_demotes_without_quarantine(self):
+        from cometbft_tpu.ops import verify as ov
+
+        rng = np.random.default_rng(4)
+        pubs, msgs, sigs = _mixed_batch(rng, 6)
+        supervisor.set_device_runner(_CountingRunner())
+        supervisor.set_fault_injector(supervisor.FaultyBackend("raise"))
+        got = ov.verify_batch(pubs, msgs, sigs)
+        assert list(got) == _oracle(pubs, msgs, sigs)
+        snap = bh.snapshot()
+        assert snap["quarantined"] == 0  # abandoned bisect is not a quarantine
+        assert snap["demotions"] >= 1
+
+    def test_bisect_kill_switch(self, monkeypatch):
+        from cometbft_tpu.ops import verify as ov
+
+        monkeypatch.setenv("COMETBFT_TPU_SUPERVISOR_BISECT", "0")
+        pubs, msgs, sigs, inject = self._poison_setup()
+        supervisor.set_device_runner(_CountingRunner())
+        supervisor.set_fault_injector(inject)
+        got = ov.verify_batch(pubs, msgs, sigs)
+        assert list(got) == _oracle(pubs, msgs, sigs)
+        snap = bh.snapshot()
+        assert snap["quarantined"] == 0
+        assert snap["demotions"] >= 1  # straight demotion instead
+
+
+# -- breaker-driven demotion / re-promotion over the chain -------------------
+
+
+class TestChainBreaker:
+    def test_open_breaker_skips_device_then_repromotes(self, monkeypatch):
+        from cometbft_tpu.ops import verify as ov
+
+        monkeypatch.setenv("COMETBFT_TPU_BREAKER_THRESHOLD", "2")
+        clk = _FakeClock()
+        bh.registry().set_clock(clk)
+        runner = _CountingRunner()
+        supervisor.set_device_runner(runner)
+        supervisor.set_fault_injector(supervisor.FaultyBackend("raise"))
+
+        seed = b"\x05" * 32
+        pub, msg = ref.pubkey_from_seed(seed), b"chain"
+        sig = ref.sign(seed, msg)
+        args = ([pub, pub], [msg, msg], [sig, sig])
+
+        ov.verify_batch(*args)  # failure 1 (bisect counted separately)
+        ov.verify_batch(*args)  # failure 2 -> open
+        assert bh.snapshot()["breakers"]["xla"]["state"] == bh.OPEN
+        calls = runner.calls
+        assert list(ov.verify_batch(*args)) == [True, True]  # host tier
+        assert runner.calls == calls  # device skipped while open
+
+        supervisor.clear_fault_injector()
+        clk.advance(1.05)  # past the initial backoff: half-open
+        assert list(ov.verify_batch(*args)) == [True, True]  # probe passes
+        snap = bh.snapshot()
+        assert snap["breakers"]["xla"]["state"] == bh.CLOSED
+        assert snap["repromotions"] == 1
+        assert runner.calls > calls  # the probe reached the device
+
+
+# -- secp256k1 / BLS fallback routing ----------------------------------------
+
+
+class TestSecpBlsRouting:
+    def test_secp_device_failure_trips_breaker(self, monkeypatch):
+        from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+        from cometbft_tpu.ops import secp_verify as sv
+
+        monkeypatch.setenv("COMETBFT_TPU_SECP_DEVICE", "1")
+        monkeypatch.setenv("COMETBFT_TPU_SIGCACHE", "0")
+        monkeypatch.setenv("COMETBFT_TPU_BREAKER_THRESHOLD", "2")
+        # fake clock: the pure-Python secp signing between batches can take
+        # >1 s of real time under full-suite load, which would let the
+        # breaker's backoff elapse and legitimately grant a half-open probe
+        bh.registry().set_clock(_FakeClock())
+        calls = {"n": 0}
+
+        def boom(*a, **k):
+            calls["n"] += 1
+            raise RuntimeError("device died")
+
+        monkeypatch.setattr(sv, "verify_batch", boom)
+
+        privs = [
+            Secp256k1PrivKey.from_secret(b"sup-secp-%d" % i) for i in range(2)
+        ]
+        msgs = [b"sm%d" % i for i in range(2)]
+
+        def run_batch():
+            bv = cbatch.Secp256k1BatchVerifier()
+            for p, m in zip(privs, msgs):
+                bv.add(p.pub_key(), m, p.sign(m))
+            return bv.verify()
+
+        ok, bits = run_batch()  # device raises -> host fallback verdicts
+        assert ok and bits == [True, True]
+        snap = bh.snapshot()
+        assert snap["breakers"]["secp_device"]["failures_total"] == 1
+        assert snap["demotions"] == 1
+
+        run_batch()  # failure 2 -> breaker opens
+        assert bh.snapshot()["breakers"]["secp_device"]["state"] == bh.OPEN
+        n = calls["n"]
+        ok, bits = run_batch()  # breaker open: device not even attempted
+        assert ok and bits == [True, True]
+        assert calls["n"] == n
+
+    def test_bls_g1_failure_trips_breaker_host_result_identical(
+        self, monkeypatch
+    ):
+        from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.crypto import bls12381 as bls
+        from cometbft_tpu.ops import bls_g1 as g1
+
+        monkeypatch.setenv("COMETBFT_TPU_BLS_DEVICE", "1")
+
+        def boom(*a, **k):
+            raise RuntimeError("g1 kernel died")
+
+        monkeypatch.setattr(g1, "batch_scalar_mul", boom)
+        pks = [bls.G1_GEN, bls.E1.mul_scalar(bls.G1_GEN, 7)]
+        rs = [3, 11]
+        got = cbatch.BlsBatchVerifier._scaled_pubkeys(pks, rs)
+        want = [bls.E1.mul_scalar(pk, r) for pk, r in zip(pks, rs)]
+        assert [bls.E1.affine(a) for a in got] == [
+            bls.E1.affine(b) for b in want
+        ]
+        snap = bh.snapshot()
+        assert snap["breakers"]["bls_g1"]["failures_total"] == 1
+        assert snap["demotions"] == 1
+
+
+# -- sigcache write-back audit -----------------------------------------------
+
+
+class TestSigcacheAudit:
+    def test_writeback_skips_non_definitive_verdicts(self, monkeypatch):
+        from cometbft_tpu.crypto import sigcache
+
+        sigcache.reset_cache()
+        seed = b"\x09" * 32
+        pub, msg = ref.pubkey_from_seed(seed), b"audit"
+        sig = ref.sign(seed, msg)
+        bits, miss = sigcache.partition_misses([pub], [msg], [sig])
+        assert miss == [0]
+        sigcache.writeback([pub], [msg], [sig], bits, miss, [None])
+        assert bits[0] is None  # hole stays a hole, not False
+        assert sigcache.get_cache().get(pub, msg, sig) is None  # NOT cached
+        sigcache.reset_cache()
+
+    def test_infra_none_surfaces_as_backend_error_not_false_bit(self):
+        from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.crypto import sigcache
+
+        sigcache.reset_cache()
+        seed = b"\x0a" * 32
+        pub, msg = ref.pubkey_from_seed(seed), b"audit2"
+        sig = ref.sign(seed, msg)  # VALID
+
+        class _InfraVerifier(cbatch._CollectingVerifier):
+            PUB_SIZES = (32,)
+            SIG_SIZES = (64,)
+
+            def _verify_pending(self, pubs, msgs, sigs):
+                return [None] * len(pubs)  # "could not judge"
+
+        bv = _InfraVerifier()
+        bv.add(pub, msg, sig)
+        with pytest.raises(bh.BackendError):
+            bv.verify()
+        # the valid signature was not negative-cached by the infra failure
+        cpu = cbatch.CpuBatchVerifier()
+        cpu.add(pub, msg, sig)
+        ok, bits = cpu.verify()
+        assert ok and bits == [True]
+        sigcache.reset_cache()
+
+    def test_verify_pending_raise_caches_nothing(self):
+        from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.crypto import sigcache
+
+        sigcache.reset_cache()
+        seed = b"\x0b" * 32
+        pub, msg = ref.pubkey_from_seed(seed), b"audit3"
+        sig = ref.sign(seed, msg)
+
+        class _RaisingVerifier(cbatch._CollectingVerifier):
+            PUB_SIZES = (32,)
+            SIG_SIZES = (64,)
+
+            def _verify_pending(self, pubs, msgs, sigs):
+                raise RuntimeError("backend exploded")
+
+        bv = _RaisingVerifier()
+        bv.add(pub, msg, sig)
+        with pytest.raises(RuntimeError):
+            bv.verify()
+        assert len(sigcache.get_cache()) == 0
+        sigcache.reset_cache()
+
+
+# -- metrics exposition ------------------------------------------------------
+
+
+class TestMetricsExposition:
+    def test_breaker_metrics_exposed(self):
+        from cometbft_tpu.libs.metrics import NodeMetrics
+
+        br = bh.registry().breaker("xla")
+        for _ in range(br.threshold):
+            br.record_failure(RuntimeError("down"))
+        bh.registry().record_demotion("xla")
+        m = NodeMetrics(namespace="t_sup")
+        page = m.registry.expose()
+        assert 't_sup_crypto_backend_breaker_state{backend="xla"} 2' in page
+        assert "t_sup_crypto_backend_demotions 1" in page
+        assert "t_sup_crypto_backend_open_breakers 1" in page
+        # scrape never initializes jax: the reads above went through
+        # backend_health only (guaranteed by construction — backend_health
+        # imports no jax; this line documents the contract)
