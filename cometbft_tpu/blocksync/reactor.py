@@ -355,23 +355,30 @@ class BlocksyncReactor(Reactor):
             return False
         first_parts = first.make_part_set()
         first_id = BlockID(hash=first.hash(), part_set_header=first_parts.header)
+        from cometbft_tpu import verifysched
+
         try:
-            # THE verification: batch Ed25519 through the pluggable seam
-            validation.verify_commit_light(
-                self.state.chain_id,
-                self.state.validators,
-                first_id,
-                first.header.height,
-                second.last_commit,
-            )
-            # The commit only signs the header hash; the block body arrived
-            # from an untrusted peer and keeps its wire-carried hashes
-            # (fill_header_hashes fills empty fields only).  Fully validate
-            # body-vs-header and header-vs-state before applying, exactly as
-            # the reference does (internal/blocksync/reactor.go:546
-            # ValidateBlock) — otherwise a peer could pair the legitimately
-            # signed header with tampered txs/last_commit/evidence.
-            self.block_exec.validate_block(self.state, first)
+            # THE verification: batch Ed25519 through the pluggable seam,
+            # tagged bulk-priority for the shared verify scheduler —
+            # catchup signature batches must never delay (and are the
+            # first to be shed behind) live consensus votes
+            with verifysched.priority_class(verifysched.PRIO_BLOCKSYNC):
+                validation.verify_commit_light(
+                    self.state.chain_id,
+                    self.state.validators,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                )
+                # The commit only signs the header hash; the block body
+                # arrived from an untrusted peer and keeps its wire-carried
+                # hashes (fill_header_hashes fills empty fields only).
+                # Fully validate body-vs-header and header-vs-state before
+                # applying, exactly as the reference does
+                # (internal/blocksync/reactor.go:546 ValidateBlock) —
+                # otherwise a peer could pair the legitimately signed
+                # header with tampered txs/last_commit/evidence.
+                self.block_exec.validate_block(self.state, first)
         except (validation.CommitVerificationError, InvalidBlockError) as e:
             self.logger.error(
                 "invalid block in blocksync",
@@ -471,6 +478,20 @@ def check_ext_commit(
     for cs in ec.extended_signatures:
         if cs.for_block() and not cs.extension_signature:
             return "commit signature missing its extension signature"
+    # bulk class for the whole check: ext-commit signature batches are
+    # catchup traffic like the rest of blocksync — they must never ride
+    # the shed-exempt consensus class ahead of live votes
+    from cometbft_tpu import verifysched
+
+    with verifysched.priority_class(verifysched.PRIO_BLOCKSYNC):
+        return _check_ext_commit_sigs(
+            chain_id, validators, block, block_id, ec, second_last_commit
+        )
+
+
+def _check_ext_commit_sigs(
+    chain_id, validators, block, block_id, ec, second_last_commit
+) -> Optional[str]:
     base = ec.to_commit()
     if base.signatures != second_last_commit.signatures:
         # usually identical to the (already verified) next block's

@@ -867,14 +867,17 @@ class ConsensusState(BaseService):
         ):
             raise VoteError("invalid proposal POL round")
         proposer = rs.validators.get_proposer()
-        # through the signature cache: a proposal regossiped by several
-        # peers (or replayed from the WAL) is verified once per process
-        from cometbft_tpu.crypto import sigcache
+        # through the signature cache + verify scheduler (consensus class):
+        # a proposal regossiped by several peers (or replayed from the WAL)
+        # is verified once per process, and on accelerator-backed nodes the
+        # check coalesces with in-flight vote verifications
+        from cometbft_tpu import verifysched
 
-        if not sigcache.verify_with_cache(
+        if not verifysched.verify_cached(
             proposer.pub_key,
             proposal.sign_bytes(self.state.chain_id),
             proposal.signature,
+            priority=verifysched.PRIO_CONSENSUS,
         ):
             raise VoteError("invalid proposal signature")
         rs.proposal = proposal
@@ -1061,15 +1064,18 @@ class ConsensusState(BaseService):
         )
         if val is None or val[1] is None:
             return False
-        from cometbft_tpu.crypto import sigcache
+        from cometbft_tpu import verifysched
 
         pub = val[1].pub_key
         # cached: blocksync's check_ext_commit re-verifies these same
-        # extension signatures when serving/validating extended commits
-        if not vote.extension_signature or not sigcache.verify_with_cache(
+        # extension signatures when serving/validating extended commits.
+        # Scheduled at consensus priority: the extension check rides the
+        # same fused dispatch as the vote signature it arrived with.
+        if not vote.extension_signature or not verifysched.verify_cached(
             pub,
             vote.extension_sign_bytes(self.state.chain_id),
             vote.extension_signature,
+            priority=verifysched.PRIO_CONSENSUS,
         ):
             self.logger.debug(
                 "rejecting precommit: bad extension signature",

@@ -194,6 +194,16 @@ class TpuBatchVerifier(_CollectingVerifier):
     SIG_SIZES = (64,)
 
     def _verify_pending(self, pubs, msgs, sigs) -> list[bool]:
+        from cometbft_tpu import verifysched
+
+        if verifysched.scheduler_active():
+            # the cache misses ride the process-wide continuous-batching
+            # scheduler (at the caller's ambient priority class), so this
+            # commit's segment coalesces with concurrent gossip/evidence/
+            # light/catchup work into one fused dispatch — the scheduler
+            # resolves only definitive supervised verdicts, matching this
+            # method's attribution contract
+            return verifysched.verify_segment_sync(pubs, msgs, sigs)
         from cometbft_tpu.ops import verify as _ops_verify
 
         return [bool(b) for b in _ops_verify.verify_batch(pubs, msgs, sigs)]

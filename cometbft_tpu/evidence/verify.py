@@ -109,9 +109,22 @@ def verify_duplicate_vote(
             f"actual {valset.total_voting_power()}"
         )
 
-    if not val.pub_key.verify_signature(a.sign_bytes(chain_id), a.signature):
+    # Both checks through the batch-verify seam + sigcache at evidence
+    # priority: the two signatures are submitted together so they ride one
+    # fused dispatch (or resolve from verdicts cached at gossip time —
+    # vote A usually IS the vote the node already admitted), instead of
+    # two bare host verifies that never populated the cache.
+    from cometbft_tpu import verifysched
+
+    ok_a, ok_b = verifysched.verify_many_cached(
+        [val.pub_key, val.pub_key],
+        [a.sign_bytes(chain_id), b.sign_bytes(chain_id)],
+        [a.signature, b.signature],
+        priority=verifysched.PRIO_EVIDENCE,
+    )
+    if not ok_a:
         raise EvidenceInvalidError("invalid signature on vote A")
-    if not val.pub_key.verify_signature(b.sign_bytes(chain_id), b.signature):
+    if not ok_b:
         raise EvidenceInvalidError("invalid signature on vote B")
 
 
@@ -133,15 +146,21 @@ def verify_light_client_attack(
         raise EvidenceInvalidError(f"invalid conflicting block: {err}")
 
     sh = ev.conflicting_block.signed_header
+    # evidence priority class: the conflicting commit's signature batch
+    # goes through the shared verify scheduler (via the batch-verifier
+    # seam) below consensus votes but above bulk catchup traffic
+    from cometbft_tpu import verifysched
+
     if ev.common_height < sh.header.height:
         # lunatic: >1/3 of common valset signed the conflicting header
         try:
-            validation.verify_commit_light_trusting(
-                chain_id,
-                common_vals,
-                sh.commit,
-                trust_level=Fraction(1, 3),
-            )
+            with verifysched.priority_class(verifysched.PRIO_EVIDENCE):
+                validation.verify_commit_light_trusting(
+                    chain_id,
+                    common_vals,
+                    sh.commit,
+                    trust_level=Fraction(1, 3),
+                )
         except validation.CommitVerificationError as e:
             raise EvidenceInvalidError(
                 f"conflicting block not signed by 1/3+ of common set: {e}"
@@ -150,13 +169,14 @@ def verify_light_client_attack(
         # equivocation at the same height: full commit check against the
         # conflicting block's own (claimed) validator set
         try:
-            validation.verify_commit_light(
-                chain_id,
-                ev.conflicting_block.validator_set,
-                sh.commit.block_id,
-                sh.header.height,
-                sh.commit,
-            )
+            with verifysched.priority_class(verifysched.PRIO_EVIDENCE):
+                validation.verify_commit_light(
+                    chain_id,
+                    ev.conflicting_block.validator_set,
+                    sh.commit.block_id,
+                    sh.header.height,
+                    sh.commit,
+                )
         except validation.CommitVerificationError as e:
             raise EvidenceInvalidError(
                 f"conflicting block commit invalid: {e}"

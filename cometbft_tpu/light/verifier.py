@@ -86,17 +86,25 @@ def verify_adjacent(
     now: float,
     max_clock_drift_s: float = 10.0,
 ) -> None:
-    """Reference: light/verifier.go:91 VerifyAdjacent."""
+    """Reference: light/verifier.go:91 VerifyAdjacent.
+
+    The commit check runs at light priority through the shared verify
+    scheduler (via the batch-verifier seam): a syncing light client's
+    signature batches coalesce with other callers' work without ever
+    delaying consensus votes (docs/verify-scheduler.md)."""
+    from cometbft_tpu import verifysched
+
     _check_adjacent_headers(
         chain_id, trusted, new, trusting_period_s, now, max_clock_drift_s
     )
-    validation.verify_commit_light(
-        chain_id,
-        new.validator_set,
-        new.signed_header.commit.block_id,
-        new.height,
-        new.signed_header.commit,
-    )
+    with verifysched.priority_class(verifysched.PRIO_LIGHT):
+        validation.verify_commit_light(
+            chain_id,
+            new.validator_set,
+            new.signed_header.commit.block_id,
+            new.height,
+            new.signed_header.commit,
+        )
 
 
 def _check_adjacent_headers(
@@ -229,24 +237,28 @@ def verify_non_adjacent(
     if header_expired(trusted.signed_header.header.time, trusting_period_s, now):
         raise ErrOldHeaderExpired("trusted header expired")
     _validate_new_block(chain_id, trusted, new, now, max_clock_drift_s)
+    from cometbft_tpu import verifysched
+
     # >trust_level of the TRUSTED set signed the new header
     try:
-        validation.verify_commit_light_trusting(
-            chain_id,
-            trusted.validator_set,
-            new.signed_header.commit,
-            trust_level=trust_level,
-        )
+        with verifysched.priority_class(verifysched.PRIO_LIGHT):
+            validation.verify_commit_light_trusting(
+                chain_id,
+                trusted.validator_set,
+                new.signed_header.commit,
+                trust_level=trust_level,
+            )
     except validation.NotEnoughPowerError as e:
         raise ErrNewValSetCantBeTrusted(str(e)) from e
     # and +2/3 of the NEW set signed it
-    validation.verify_commit_light(
-        chain_id,
-        new.validator_set,
-        new.signed_header.commit.block_id,
-        new.height,
-        new.signed_header.commit,
-    )
+    with verifysched.priority_class(verifysched.PRIO_LIGHT):
+        validation.verify_commit_light(
+            chain_id,
+            new.validator_set,
+            new.signed_header.commit.block_id,
+            new.height,
+            new.signed_header.commit,
+        )
 
 
 class ErrNewValSetCantBeTrusted(VerificationError):

@@ -19,6 +19,9 @@ Built-ins:
   * ``backend-wedge``      — device dispatches hang past the watchdog
   * ``backend-flap``       — device fails in bursts; breaker must cycle
     open -> half-open -> closed with exponential backoff
+  * ``gossip-burst``       — vote storm + bulk-class submission bursts
+    overload the verification scheduler's bounded queue; only bulk items
+    may shed, consensus votes never, agreement must hold
 
 The backend-* scenarios force the supervised device verify path
 (``COMETBFT_TPU_CRYPTO_BACKEND=tpu`` — verdict-equal on CPU hosts via the
@@ -86,6 +89,9 @@ class ScenarioResult:
     # backend supervisor counters captured at end-of-run (backend-* fault
     # scenarios only): demotions, repromotions, watchdog_fires, breakers…
     backend: dict = field(default_factory=dict)
+    # verify-scheduler counters captured at end-of-run (scenarios that
+    # force the tpu backend): submitted/shed per class, flushes, dedup…
+    sched: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -104,6 +110,13 @@ class ScenarioResult:
         }
         if self.backend:
             row["backend"] = self.backend
+        if self.sched:
+            row["sched"] = {
+                "submitted": self.sched["submitted"],
+                "shed": self.sched["shed"],
+                "flushes": self.sched["flushes"],
+                "dedup_hits": self.sched["dedup_hits"],
+            }
         return row
 
 
@@ -169,6 +182,9 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_DISPATCH_TIMEOUT_MS",
     "COMETBFT_TPU_BREAKER_THRESHOLD",
     "COMETBFT_TPU_SUPERVISOR_BISECT",
+    "COMETBFT_TPU_VERIFY_SCHED",
+    "COMETBFT_TPU_SCHED_FLUSH_US",
+    "COMETBFT_TPU_SCHED_QUEUE",
 )
 
 
@@ -208,6 +224,11 @@ def _backend_faults_setup(extra_env: Optional[dict] = None):
         # without this every apply-time commit would resolve from verdicts
         # cached at gossip time and the fault window would exercise nothing
         os.environ["COMETBFT_TPU_SIGCACHE"] = "0"
+        # scheduler OFF by default: the backend-* scenarios exercise the
+        # supervisor chain BELOW the scheduler, and the per-verify flush
+        # deadline would only slow them; gossip-burst re-enables it via
+        # extra_env (it is the scheduler's own scenario)
+        os.environ["COMETBFT_TPU_VERIFY_SCHED"] = "0"
         supervisor.clear_fault_injector()
         if os.environ.get("COMETBFT_TPU_SIM_REAL_DEVICE") == "1":
             # slow lane: real XLA dispatches.  Warm the kernel BEFORE the
@@ -233,14 +254,27 @@ def _backend_faults_setup(extra_env: Optional[dict] = None):
         # after the warmup so its breaker traffic doesn't leak into stats
         backend_health.reset()
         backend_health.registry().set_clock(cluster.clock.now)
+        # fresh verify scheduler so it re-reads the scenario's flush/queue
+        # knobs (the tpu backend forced above activates it), with clean
+        # stats for the run's ScenarioResult capture
+        from cometbft_tpu import verifysched
+
+        verifysched.reset_scheduler()
+        verifysched.stats.reset()
 
     return setup
 
 
 def _backend_faults_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu import verifysched
     from cometbft_tpu.crypto import backend_health
     from cometbft_tpu.crypto import batch as cbatch
 
+    # drain + drop the scenario's scheduler BEFORE the env knobs flip back
+    # (its dispatcher must finish under the scenario's device runner), and
+    # zero its stats so nothing leaks into later tests
+    verifysched.reset_scheduler()
+    verifysched.stats.reset()
     supervisor.clear_fault_injector()
     supervisor.clear_device_runner()
     saved_env, saved_backend = getattr(cluster, "_backend_saved", ({}, None))
@@ -332,6 +366,64 @@ def _backend_flap(s: Scenario) -> list[Action]:
     ]
 
 
+def _gossip_burst(s: Scenario) -> list[Action]:
+    """Vote storm + scripted bulk-verify overload against the continuous-
+    batching verification scheduler (docs/verify-scheduler.md): links
+    duplicate and reorder gossip (so the same vote signature reaches nodes
+    repeatedly and concurrently-queued duplicates exercise the in-flight
+    dedup), while scripted bursts of seeded bulk-class submissions slam the
+    scheduler's bounded queue past its (scenario-shrunk) capacity.
+    Admission control must shed ONLY bulk-class items; consensus votes are
+    exempt by design, so agreement and progress must be untouched and the
+    trace stays byte-identical per seed (verdicts never depend on how items
+    happened to coalesce)."""
+
+    def storm(c: SimCluster) -> None:
+        c.net.set_all_links(dup_rate=0.25, reorder_rate=0.5, reorder_jitter=0.5)
+
+    def burst(c: SimCluster) -> None:
+        import hashlib
+
+        from cometbft_tpu import verifysched
+
+        sched = verifysched.get_scheduler()
+        tag = b"gossip-burst-%d-%d" % (c.seed, int(c.clock.now() * 1000))
+        shed = 0
+        futs = []
+        # pause/resume brackets the burst so the overload is deterministic:
+        # the sim is single-threaded (every consensus verify blocks on its
+        # future), so the queue is empty here, the dispatcher cannot drain
+        # mid-burst, and exactly queue_cap items are admitted
+        sched.pause()
+        try:
+            for i in range(256):
+                h = hashlib.sha256(tag + b"-%d" % i).digest()
+                try:
+                    futs.append(
+                        sched.submit(
+                            h,  # structurally valid, crypto garbage
+                            b"burst-msg-%d" % i,
+                            h + h,
+                            verifysched.PRIO_BLOCKSYNC,
+                        )
+                    )
+                except verifysched.QueueFullError:
+                    shed += 1
+        finally:
+            sched.resume()
+        # wait the admitted items out: the queue is empty again before the
+        # action returns, so the next burst's shed count (logged into the
+        # byte-compared trace) cannot depend on dispatcher wall-time
+        for f in futs:
+            assert f.result(timeout=30) is False  # garbage never verifies
+        c._log("scenario: bulk burst of 256 submissions, %d shed" % shed)
+
+    return [Action(0.0, "storm links: dup 25%, reorder 50%", storm)] + [
+        Action(float(t), "bulk verify burst (256 items)", burst)
+        for t in (3, 5, 7)
+    ]
+
+
 def _message_storm(s: Scenario) -> list[Action]:
     def inject_txs(c: SimCluster) -> None:
         h = c.live_nodes()[0].cs.rs.height
@@ -392,6 +484,26 @@ SCENARIOS: dict[str, Scenario] = {
             "duplicate and aggressively reorder every link while txs flow",
             max_time=240.0,
             actions=_message_storm,
+        ),
+        Scenario(
+            "gossip-burst",
+            "vote storm (dup/reorder links) plus scripted 256-item bulk "
+            "bursts against a 48-slot verify-scheduler queue: admission "
+            "control must shed only bulk-class items, never consensus "
+            "votes; agreement holds and traces stay byte-identical per "
+            "seed.  Runs on the host-oracle device-runner seam so tier-1 "
+            "never pays real XLA dispatches",
+            target_height=6,
+            max_time=180.0,
+            actions=_gossip_burst,
+            setup=_backend_faults_setup(
+                {
+                    "COMETBFT_TPU_VERIFY_SCHED": "1",
+                    "COMETBFT_TPU_SCHED_QUEUE": "48",
+                    "COMETBFT_TPU_SCHED_FLUSH_US": "500",
+                }
+            ),
+            teardown=_backend_faults_teardown,
         ),
         Scenario(
             "backend-brownout",
@@ -481,6 +593,7 @@ def run_scenario(
             label=f"scenario {action.name}",
         )
     backend_stats: dict = {}
+    sched_stats: dict = {}
     try:
         if scenario.setup is not None:
             scenario.setup(cluster)
@@ -505,6 +618,13 @@ def run_scenario(
                     n: b["state"] for n, b in snap["breakers"].items()
                 },
             }
+            # only when the scenario ran with the scheduler enabled —
+            # backend-* scenarios pin it off, and an all-zero sched block
+            # in their soak rows would read as "scheduler ran, idle"
+            if os.environ.get("COMETBFT_TPU_VERIFY_SCHED", "1") != "0":
+                from cometbft_tpu.verifysched import stats as sstats
+
+                sched_stats = sstats.snapshot()
     finally:
         if scenario.teardown is not None:
             scenario.teardown(cluster)
@@ -525,4 +645,5 @@ def run_scenario(
         trace=cluster.trace,
         cluster=cluster if keep_cluster else None,
         backend=backend_stats,
+        sched=sched_stats,
     )

@@ -75,14 +75,23 @@ class Vote:
     def verify(self, chain_id: str, pub_key) -> bool:
         """Reference: types/vote.go:227 — single-signature path.
 
-        Routed through the consensus-wide signature cache: a precommit
-        verified here at gossip time makes the commit built from it
-        near-free to re-verify at apply/blocksync time (the CommitSig
-        reconstructs byte-identical sign bytes from the same timestamp)."""
-        from cometbft_tpu.crypto import sigcache
+        Routed through the consensus-wide signature cache AND the
+        continuous-batching scheduler (consensus priority class): on an
+        accelerator-backed node, concurrent gossip-time verifications from
+        many peers coalesce into one fused device dispatch instead of each
+        paying a one-signature dispatch or host verify
+        (docs/verify-scheduler.md); elsewhere this is exactly the cached
+        host path.  Either way a precommit verified here at gossip time
+        makes the commit built from it near-free to re-verify at
+        apply/blocksync time (the CommitSig reconstructs byte-identical
+        sign bytes from the same timestamp)."""
+        from cometbft_tpu import verifysched
 
-        return sigcache.verify_with_cache(
-            pub_key, self.sign_bytes(chain_id), self.signature
+        return verifysched.verify_cached(
+            pub_key,
+            self.sign_bytes(chain_id),
+            self.signature,
+            priority=verifysched.PRIO_CONSENSUS,
         )
 
     def copy(self) -> "Vote":

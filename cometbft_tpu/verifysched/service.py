@@ -1,0 +1,622 @@
+"""Continuous-batching asynchronous verification service.
+
+The device kernel only earns its keep when batches are full, yet every
+caller on the verify hot path historically assembled its *own* batch and
+blocked on its *own* dispatch: gossip-time ``Vote.verify`` paid a
+one-signature dispatch (or the host fallback), evidence checks verified
+per vote, and concurrent submitters each ate the per-dispatch floor.  This
+module is the missing shared engine — the continuous-batching scheduler
+shape from inference serving applied to signature verification
+(docs/verify-scheduler.md):
+
+  * callers ``submit(pub, msg, sig, priority)`` and get back a Future (or
+    bridge whole segments via ``verify_segment_sync``);
+  * one dispatcher thread coalesces pending items ACROSS all submitters
+    into a single ``ops/verify.verify_segments`` dispatch, flushing when
+    the oldest item has waited ``COMETBFT_TPU_SCHED_FLUSH_US`` (~2000) or
+    when a padding bucket fills (at which point the dispatch carries zero
+    padding waste);
+  * the sigcache is consulted before any queue slot or device lane is
+    occupied, and duplicate in-flight triples (the same vote gossiped by
+    two peers at once) collapse into one lane;
+  * everything below the flush runs under the existing ``ops/supervisor``
+    chain, so futures ALWAYS complete with definitive verdicts — an
+    infrastructure failure degrades pallas -> xla -> host, never becomes a
+    False accept bit (tests/test_verifysched.py pins this with
+    ``FaultyBackend``).
+
+Priority classes and admission control: ``consensus`` (vote/proposal/
+extension checks) > ``evidence_light`` (evidence, light client) > ``bulk``
+(blocksync, mempool).  The queue is bounded (``COMETBFT_TPU_SCHED_QUEUE``,
+default 8192); overload sheds ONLY non-consensus classes — a shed caller
+falls back to its own synchronous verify (it loses the batching win, never
+the verdict) — while consensus submissions are always admitted: consensus
+traffic is bounded by validator count x rounds, and blocking or dropping a
+vote is a liveness hazard no queue bound justifies.
+
+Activation: the scheduler takes the verify path only when
+``COMETBFT_TPU_VERIFY_SCHED`` != 0 (default on) AND the accelerator batch
+backend is trusted (``crypto.batch.default_backend() == "tpu"`` — the same
+gate the fused stream uses).  Otherwise every wrapper here falls through
+to the exact pre-scheduler code path, so the kill switch
+``COMETBFT_TPU_VERIFY_SCHED=0`` restores prior behavior bit-for-bit.
+``verify_now`` is the synchronous escape hatch for callers that cannot
+tolerate queueing latency.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+from cometbft_tpu.crypto import sigcache
+from cometbft_tpu.verifysched import stats
+
+logger = logging.getLogger("cometbft_tpu.verifysched")
+
+# Priority classes (lower = more important).  EVIDENCE/LIGHT share a class,
+# as do BLOCKSYNC/MEMPOOL — three queues cover the real urgency tiers.
+PRIO_CONSENSUS = 0
+PRIO_EVIDENCE = 1
+PRIO_LIGHT = 1
+PRIO_BLOCKSYNC = 2
+PRIO_MEMPOOL = 2
+N_CLASSES = 3
+
+DEFAULT_FLUSH_US = 2000.0
+DEFAULT_QUEUE_CAP = 8192
+# largest fusable bucket (mirrors ops.verify._BUCKETS[-1]); a single drain
+# never exceeds it — leftovers stay queued for the next flush
+MAX_DRAIN = 32768
+
+
+class QueueFullError(Exception):
+    """Admission control rejected a non-consensus submission (backpressure).
+    The caller verifies synchronously instead — shedding costs the batching
+    win, never the verdict."""
+
+
+def enabled() -> bool:
+    return os.environ.get("COMETBFT_TPU_VERIFY_SCHED", "1") != "0"
+
+
+def scheduler_active() -> bool:
+    """True when submissions should take the scheduler path: kill switch on
+    AND the accelerator batch backend trusted — the same ``tpu`` gate the
+    fused stream and blocksync prefetch use, so a CPU-backend node (whose
+    host library path has no dispatch floor to amortize) keeps today's
+    synchronous behavior untouched.
+
+    Deliberately NEVER calls ``cbatch.default_backend()``'s auto-probe:
+    that would import jax and initialize a backend from gossip-time
+    ``Vote.verify`` in processes that otherwise never touch the device
+    (every CPU e2e node pays seconds of init on its first vote).  With the
+    backend unconfigured and still unresolved, the scheduler stays off; it
+    activates the moment the batch seam's own first use resolves the
+    backend to ``tpu``."""
+    if not enabled():
+        return False
+    from cometbft_tpu.crypto import batch as cbatch
+
+    env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
+    if env and env != "auto":
+        return env == "tpu"
+    return cbatch._DEFAULT_BACKEND == "tpu"
+
+
+# -- per-thread priority class ----------------------------------------------
+
+_TLS = threading.local()
+
+
+def current_priority() -> int:
+    """The ambient priority class for this thread.  Call sites that reach
+    the scheduler through deep shared layers (the ``_CollectingVerifier``
+    bridge under ``types/validation``) tag their work with
+    ``priority_class`` instead of plumbing an argument through every
+    signature-verification API.
+
+    FAIL-CLOSED default: untagged work is BULK (sheddable).  The
+    consensus class is shed-exempt and skips the queue bound, so handing
+    it out implicitly would let any future untagged caller bypass
+    admission control and starve every other class; the three consensus
+    sites (vote, proposal, vote-extension) pass ``priority=`` explicitly."""
+    return getattr(_TLS, "prio", PRIO_BLOCKSYNC)
+
+
+@contextlib.contextmanager
+def priority_class(priority: int):
+    prev = getattr(_TLS, "prio", None)
+    _TLS.prio = int(priority)
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _TLS.prio
+        else:
+            _TLS.prio = prev
+
+
+# -- the scheduler -----------------------------------------------------------
+
+
+class _Item:
+    __slots__ = ("pub", "msg", "sig", "prio", "future", "t0")
+
+    def __init__(self, pub, msg, sig, prio, future, t0):
+        self.pub = pub
+        self.msg = msg
+        self.sig = sig
+        self.prio = prio
+        self.future = future
+        self.t0 = t0
+
+
+class VerifyScheduler:
+    """One dispatcher thread over three priority queues.  Thread-safe;
+    lazily starts its thread on the first queued submission and drains
+    everything (reason ``shutdown``) on ``close()`` — a future handed out
+    is always eventually resolved."""
+
+    def __init__(
+        self,
+        flush_us: Optional[float] = None,
+        queue_cap: Optional[int] = None,
+    ):
+        if flush_us is None:
+            try:
+                flush_us = float(
+                    os.environ.get("COMETBFT_TPU_SCHED_FLUSH_US", "")
+                    or DEFAULT_FLUSH_US
+                )
+            except ValueError:
+                flush_us = DEFAULT_FLUSH_US
+        if queue_cap is None:
+            try:
+                queue_cap = int(
+                    os.environ.get("COMETBFT_TPU_SCHED_QUEUE", "")
+                    or DEFAULT_QUEUE_CAP
+                )
+            except ValueError:
+                queue_cap = DEFAULT_QUEUE_CAP
+        self.flush_s = max(flush_us, 0.0) / 1e6
+        self.queue_cap = max(int(queue_cap), 1)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: "list[deque[_Item]]" = [
+            deque() for _ in range(N_CLASSES)
+        ]
+        self._count = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._paused = False
+        self._full_target: Optional[int] = None
+
+    # -- submission -------------------------------------------------------
+
+    def submit(
+        self,
+        pub: bytes,
+        msg: bytes,
+        sig: bytes,
+        priority: int = PRIO_CONSENSUS,
+        precleared: bool = False,
+    ) -> "Future[bool]":
+        """Queue one (pub, msg, sig) check; returns a Future resolving to
+        the definitive verdict.  A sigcache hit resolves immediately
+        without occupying a queue slot (``precleared=True`` skips that
+        lookup — for bridges that just partitioned the cache themselves).
+        Raises ``QueueFullError`` for non-consensus classes when the queue
+        is at capacity; consensus submissions are always admitted."""
+        prio = min(max(int(priority), 0), N_CLASSES - 1)
+        fut: "Future[bool]" = Future()
+        if not precleared:
+            hit = sigcache.get_cache().get(pub, msg, sig)
+            if hit is not None:
+                stats.record_submit_hit(prio)
+                fut.set_result(bool(hit))
+                return fut
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("verify scheduler is stopped")
+            if prio != PRIO_CONSENSUS and self._count >= self.queue_cap:
+                stats.record_shed(prio)
+                raise QueueFullError(
+                    f"verify queue at capacity ({self.queue_cap}); "
+                    f"shedding class {stats.CLASS_NAMES[prio]}"
+                )
+            self._queues[prio].append(
+                _Item(pub, msg, sig, prio, fut, time.perf_counter())
+            )
+            self._count += 1
+            stats.record_submit(prio)
+            if self._thread is None or not self._thread.is_alive():
+                # lazily started — and RESTARTED if it ever died (an
+                # exception escaping even the _execute fallback, e.g.
+                # MemoryError): without this, every queued future would
+                # hang forever and take consensus with it.  The new
+                # thread drains whatever the dead one left queued.
+                if self._thread is not None:
+                    logger.error(
+                        "verify dispatcher thread died; restarting "
+                        "(%d items pending)",
+                        self._count,
+                    )
+                self._thread = threading.Thread(
+                    target=self._run, name="verify-sched", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return fut
+
+    def submit_many(
+        self,
+        pubs: Sequence[bytes],
+        msgs: Sequence[bytes],
+        sigs: Sequence[bytes],
+        priority: int = PRIO_CONSENSUS,
+        precleared: bool = False,
+    ) -> "list[Optional[Future]]":
+        """Submit a whole segment before waiting on any item, so the
+        pieces can coalesce into one flush.  Entries the admission control
+        sheds come back as ``None`` — the caller verifies those itself.
+        A scheduler stopped mid-segment (teardown race) marks the rest
+        ``None`` the same way: already-queued futures still resolve (close
+        drains the queue), the remainder degrade to the caller's fallback."""
+        out: "list[Optional[Future]]" = []
+        for p, m, s in zip(pubs, msgs, sigs):
+            try:
+                out.append(self.submit(p, m, s, priority, precleared))
+            except QueueFullError:
+                out.append(None)
+            except RuntimeError:
+                out.extend([None] * (len(msgs) - len(out)))
+                break
+        return out
+
+    # -- test/bench hooks -------------------------------------------------
+
+    def pause(self) -> None:
+        """Hold flushing (test/bench hook: build a deterministic backlog)."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._count
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting work, drain the queue (reason ``shutdown``) and
+        join the dispatcher.  Every outstanding future resolves.  A
+        dispatcher wedged past the join timeout (a stuck device dispatch)
+        is surfaced loudly: the caller may be about to restore global
+        state (env knobs, device-runner seam, stats) that the straggling
+        flush would then run — and record — under."""
+        with self._cond:
+            self._stopped = True
+            self._paused = False
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            if t.is_alive():
+                logger.warning(
+                    "verify scheduler dispatcher still alive %.1fs after "
+                    "close() — a wedged flush will finish under whatever "
+                    "global state exists when it unwedges",
+                    timeout_s,
+                )
+
+    # -- dispatcher -------------------------------------------------------
+
+    def _bucket_target(self) -> int:
+        """Items that fill the smallest padding bucket for the active
+        kernel: flushing there costs zero padding waste, so waiting any
+        longer only adds latency.  Computed once, off the submit path (the
+        ops import pulls in jax)."""
+        if self._full_target is None:
+            try:
+                from cometbft_tpu.ops import verify as ov
+
+                self._full_target = ov.bucket_size(1, ov._min_bucket())
+            except Exception:  # noqa: BLE001 — conservative fallback
+                self._full_target = 128
+        return self._full_target
+
+    def _oldest_t0(self) -> Optional[float]:
+        heads = [q[0].t0 for q in self._queues if q]
+        return min(heads) if heads else None
+
+    def _drain(self) -> "list[_Item]":
+        out: "list[_Item]" = []
+        for q in self._queues:  # consensus first
+            while q and len(out) < MAX_DRAIN:
+                out.append(q.popleft())
+        self._count -= len(out)
+        return out
+
+    def _run(self) -> None:
+        full = self._bucket_target()  # jax import happens here, unlocked
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                    self._count == 0 or self._paused
+                ):
+                    self._cond.wait()
+                if self._stopped and self._count == 0:
+                    return
+                reason = "shutdown"
+                if not self._stopped:
+                    while True:
+                        if self._stopped:
+                            break
+                        if self._paused:
+                            break
+                        if self._count >= full:
+                            reason = "full"
+                            break
+                        oldest = self._oldest_t0()
+                        if oldest is None:
+                            break
+                        remain = oldest + self.flush_s - time.perf_counter()
+                        if remain <= 0:
+                            reason = "deadline"
+                            break
+                        self._cond.wait(remain)
+                    if self._paused and not self._stopped:
+                        continue
+                    if self._count == 0:
+                        continue
+                items = self._drain()
+            if items:
+                self._execute(items, reason)
+
+    # -- flush ------------------------------------------------------------
+
+    def _execute(self, items: "list[_Item]", reason: str) -> None:
+        recorded = [False]
+        try:
+            self._execute_inner(items, reason, recorded)
+        except BaseException as e:  # noqa: BLE001 — futures must ALWAYS
+            # resolve: these items left the queue, so the submit-path
+            # dispatcher restart can never recover them — an unresolved
+            # future here is a permanent consensus hang in result()
+            logger.exception(
+                "verify flush failed unexpectedly; resolving %d items on "
+                "the host reference",
+                len(items),
+            )
+            from cometbft_tpu.crypto import ed25519_ref as ref
+
+            # exactly-once flush accounting: if the inner pass failed
+            # before recording, account the drained items here or
+            # queue_depth stays inflated forever
+            if not recorded[0]:
+                stats.record_flush(
+                    reason, items=len(items), misses=0, lanes=0
+                )
+            now = time.perf_counter()
+            for it in items:
+                if it.future.done():
+                    continue
+                try:
+                    ok = len(it.pub) == 32 and len(it.sig) == 64 and bool(
+                        ref.verify_zip215(it.pub, it.msg, it.sig)
+                    )
+                except Exception:  # noqa: BLE001 — malformed input
+                    ok = False
+                it.future.set_result(ok)
+                stats.record_verdict(it.prio, now - it.t0)
+            if not isinstance(e, Exception):
+                raise  # SystemExit etc.: die, but only AFTER resolving
+                # (the next submit detects the dead thread and restarts)
+
+    def _execute_inner(
+        self, items: "list[_Item]", reason: str, recorded: "list[bool]"
+    ) -> None:
+        n = len(items)
+        pubs = [it.pub for it in items]
+        msgs = [it.msg for it in items]
+        sigs = [it.sig for it in items]
+
+        # structural filter (garbage never occupies a device lane) +
+        # in-flight dedup: concurrent gossip of the same vote collapses
+        # into one lane, both futures share the verdict
+        bits: "list[Optional[bool]]" = [None] * n
+        uniq: "OrderedDict[bytes, list[int]]" = OrderedDict()
+        for i in range(n):
+            if len(pubs[i]) != 32 or len(sigs[i]) != 64:
+                bits[i] = False
+                continue
+            k = sigcache._key(pubs[i], msgs[i], sigs[i])
+            uniq.setdefault(k, []).append(i)
+        firsts = [ixs[0] for ixs in uniq.values()]
+        stats.record_dedup(sum(len(ixs) - 1 for ixs in uniq.values()))
+
+        lanes = 0
+        if firsts:
+            from cometbft_tpu.ops import verify as ov
+
+            # one segment per priority class present: verify_segments fuses
+            # them into ONE dispatch (recording cross-class fusion in
+            # ops/dispatch_stats) and splits the bits back per class
+            by_class: "list[list[int]]" = [[] for _ in range(N_CLASSES)]
+            for i in firsts:
+                by_class[items[i].prio].append(i)
+            ordered = [i for cls in by_class for i in cls]
+            work = [
+                (
+                    [pubs[i] for i in cls],
+                    [msgs[i] for i in cls],
+                    [sigs[i] for i in cls],
+                )
+                for cls in by_class
+                if cls
+            ]
+            lanes = ov.bucket_size(len(ordered), ov._min_bucket())
+            results = ov.verify_segments(work)
+            # verdicts keyed by FIRST index of each dedup group (the hash
+            # was already paid once in the dedup loop above)
+            verdict_by_first = dict(
+                zip(ordered, (bool(b) for seg in results for b in seg))
+            )
+            # resolve every member of each dedup group + cache writeback.
+            # Inlined rather than sigcache.writeback: that would re-hash
+            # every entry, and the dedup loop already holds the keys —
+            # on the single dispatcher thread a third SHA-256 per item
+            # gates every waiter's latency.  Supervised verdicts are
+            # always definitive, so caching unconditionally is safe.
+            cache = sigcache.get_cache()
+            cache_on = cache.enabled()
+            for k, ixs in uniq.items():
+                v = verdict_by_first[ixs[0]]
+                for i in ixs:
+                    bits[i] = v
+                if cache_on:
+                    cache._put(k, v)
+
+        # record BEFORE resolving: set_result unblocks waiters, and a
+        # caller reading stats right after its verdict (the sim's
+        # end-of-run capture asserts queue_depth == 0) must not race the
+        # dispatcher's bookkeeping; ``recorded`` keeps the _execute
+        # fallback from double-counting if a resolve below raises
+        stats.record_flush(reason, items=n, misses=len(firsts), lanes=lanes)
+        recorded[0] = True
+        now = time.perf_counter()
+        for i, it in enumerate(items):
+            it.future.set_result(bool(bits[i]))
+            stats.record_verdict(it.prio, now - it.t0)
+
+
+# -- process-wide instance ----------------------------------------------------
+
+_SCHED: Optional[VerifyScheduler] = None
+_SCHED_LOCK = threading.Lock()
+
+
+def get_scheduler() -> VerifyScheduler:
+    """The process-wide scheduler (consensus, evidence, light and blocksync
+    all share one — that sharing IS the optimization)."""
+    global _SCHED
+    if _SCHED is None:
+        with _SCHED_LOCK:
+            if _SCHED is None:
+                _SCHED = VerifyScheduler()
+    return _SCHED
+
+
+def reset_scheduler() -> None:
+    """Drain + drop the process-wide scheduler (tests/sim; also re-reads
+    the flush/queue env knobs on next use)."""
+    global _SCHED
+    with _SCHED_LOCK:
+        sched, _SCHED = _SCHED, None
+    if sched is not None:
+        sched.close()
+
+
+# -- call-site wrappers -------------------------------------------------------
+
+
+def _ed25519_pub(pub_key) -> Optional[bytes]:
+    from cometbft_tpu.crypto import keys as ck
+
+    if getattr(pub_key, "type_", None) != ck.ED25519_KEY_TYPE:
+        return None
+    return pub_key.bytes() if hasattr(pub_key, "bytes") else bytes(pub_key)
+
+
+def verify_now(pub_key, msg: bytes, sig: bytes) -> bool:
+    """Synchronous escape hatch: cache-through single verification with no
+    queueing — exactly the pre-scheduler path."""
+    return sigcache.verify_with_cache(pub_key, msg, sig)
+
+
+def verify_cached(pub_key, msg: bytes, sig: bytes, priority=None) -> bool:
+    """THE drop-in for ``sigcache.verify_with_cache`` on scheduler-wired
+    call sites (gossip-time ``Vote.verify``, proposal and vote-extension
+    checks, evidence).  Scheduler inactive, non-ed25519 key, or shed by
+    admission control -> the synchronous path, verdict-identical."""
+    prio = current_priority() if priority is None else priority
+    if scheduler_active():
+        pub = _ed25519_pub(pub_key)
+        if pub is not None:
+            try:
+                return bool(
+                    get_scheduler().submit(pub, msg, sig, prio).result()
+                )
+            except QueueFullError:
+                pass  # shed (recorded): verify synchronously below
+            except RuntimeError:
+                pass  # scheduler torn down under us (reset race): sync path
+    return verify_now(pub_key, msg, sig)
+
+
+def verify_many_cached(
+    pub_keys, msgs: Sequence[bytes], sigs: Sequence[bytes], priority=None
+) -> "list[bool]":
+    """Several independent checks submitted before waiting on any, so they
+    ride one flush (evidence checks both duplicate-vote signatures this
+    way).  Falls back per item on shed / inactive / non-ed25519."""
+    prio = current_priority() if priority is None else priority
+    out: "list[Optional[bool]]" = [None] * len(msgs)
+    futs: "list[Optional[Future]]" = [None] * len(msgs)
+    if scheduler_active():
+        sched = get_scheduler()
+        for i, (pk, m, s) in enumerate(zip(pub_keys, msgs, sigs)):
+            pub = _ed25519_pub(pk)
+            if pub is None:
+                continue
+            try:
+                futs[i] = sched.submit(pub, m, s, prio)
+            except (QueueFullError, RuntimeError):
+                futs[i] = None  # shed or torn down: sync fallback below
+    for i, (pk, m, s) in enumerate(zip(pub_keys, msgs, sigs)):
+        if futs[i] is not None:
+            out[i] = bool(futs[i].result())
+        else:
+            out[i] = verify_now(pk, m, s)
+    return out
+
+
+def verify_segment_sync(
+    pubs: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    priority=None,
+) -> "list[bool]":
+    """The batch-verifier bridge: submit a pre-partitioned segment of raw
+    ed25519 triples (the caller — ``_CollectingVerifier`` — already took
+    its cache hits) and wait for all verdicts.  Entries shed by admission
+    control are verified in one direct supervised dispatch instead, so the
+    call never blocks on queue capacity."""
+    prio = current_priority() if priority is None else priority
+    futs = get_scheduler().submit_many(
+        pubs, msgs, sigs, prio, precleared=True
+    )
+    shed = [i for i, f in enumerate(futs) if f is None]
+    direct: dict = {}
+    if shed:
+        from cometbft_tpu.ops import verify as ov
+
+        got = ov.verify_batch(
+            [pubs[i] for i in shed],
+            [msgs[i] for i in shed],
+            [sigs[i] for i in shed],
+        )
+        direct = {i: bool(b) for i, b in zip(shed, got)}
+    return [
+        direct[i] if f is None else bool(f.result())
+        for i, f in enumerate(futs)
+    ]

@@ -1,0 +1,126 @@
+"""Process-wide counters for the verification scheduler.
+
+Deliberately free of jax imports, exactly like ``ops/dispatch_stats``:
+``libs/metrics.NodeMetrics`` reads these through callback gauges and a
+/metrics scrape must never be the thing that initializes an accelerator
+backend.  ``verifysched/service.py`` writes them (and computes the padded
+lane count at flush time, where ``ops.verify`` is already imported, so this
+module never has to).
+
+Counters (all guarded by one lock):
+  * ``submitted[class]``     — items admitted to the queue, per priority class
+  * ``submit_hits[class]``   — submissions resolved from the sigcache without
+    ever occupying a queue slot
+  * ``shed[class]``          — submissions rejected by admission control
+    (never ``consensus``: that class is exempt from shedding by design)
+  * ``queue_depth``          — items currently pending (gauge-style)
+  * ``flushes[reason]``      — dispatcher flushes by trigger:
+    ``deadline`` / ``full`` / ``shutdown``
+  * ``flush_items``          — items drained across all flushes
+  * ``flush_misses``         — unique cache-missing items shipped to the
+    verify seam (<= flush_items: duplicates and fresh cache hits resolve
+    on the host)
+  * ``flush_lanes``          — bucket-padded device lanes those misses
+    occupied (occupancy = flush_misses / flush_lanes)
+  * ``dedup_hits``           — duplicate in-flight triples collapsed into a
+    single lane at flush time (concurrent gossip of the same vote)
+  * ``verdicts[class]`` / ``latency_seconds[class]`` — resolved futures and
+    cumulative submit->verdict latency, per priority class
+"""
+
+from __future__ import annotations
+
+import threading
+
+CLASS_NAMES = ("consensus", "evidence_light", "bulk")
+FLUSH_REASONS = ("deadline", "full", "shutdown")
+
+_LOCK = threading.Lock()
+
+
+def _zero() -> dict:
+    return {
+        "submitted": {c: 0 for c in CLASS_NAMES},
+        "submit_hits": {c: 0 for c in CLASS_NAMES},
+        "shed": {c: 0 for c in CLASS_NAMES},
+        "queue_depth": 0,
+        "flushes": {r: 0 for r in FLUSH_REASONS},
+        "flush_items": 0,
+        "flush_misses": 0,
+        "flush_lanes": 0,
+        "dedup_hits": 0,
+        "verdicts": {c: 0 for c in CLASS_NAMES},
+        "latency_seconds": {c: 0.0 for c in CLASS_NAMES},
+    }
+
+
+_STATS = _zero()
+
+
+def _cls(priority: int) -> str:
+    return CLASS_NAMES[min(max(int(priority), 0), len(CLASS_NAMES) - 1)]
+
+
+def record_submit(priority: int) -> None:
+    with _LOCK:
+        _STATS["submitted"][_cls(priority)] += 1
+        _STATS["queue_depth"] += 1
+
+
+def record_submit_hit(priority: int) -> None:
+    with _LOCK:
+        _STATS["submit_hits"][_cls(priority)] += 1
+
+
+def record_shed(priority: int) -> None:
+    with _LOCK:
+        _STATS["shed"][_cls(priority)] += 1
+
+
+def record_flush(reason: str, items: int, misses: int, lanes: int) -> None:
+    with _LOCK:
+        _STATS["flushes"][reason] = _STATS["flushes"].get(reason, 0) + 1
+        _STATS["flush_items"] += int(items)
+        _STATS["flush_misses"] += int(misses)
+        _STATS["flush_lanes"] += int(lanes)
+        _STATS["queue_depth"] = max(0, _STATS["queue_depth"] - int(items))
+
+
+def record_dedup(n: int) -> None:
+    if n:
+        with _LOCK:
+            _STATS["dedup_hits"] += int(n)
+
+
+def record_verdict(priority: int, latency_s: float) -> None:
+    with _LOCK:
+        c = _cls(priority)
+        _STATS["verdicts"][c] += 1
+        _STATS["latency_seconds"][c] += float(latency_s)
+
+
+def queue_depth() -> int:
+    with _LOCK:
+        return _STATS["queue_depth"]
+
+
+def snapshot() -> dict:
+    """Deep-enough copy for metrics/tests; adds derived aggregates."""
+    with _LOCK:
+        out = {
+            k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in _STATS.items()
+        }
+    out["flush_occupancy"] = (
+        out["flush_misses"] / out["flush_lanes"] if out["flush_lanes"] else 0.0
+    )
+    out["verdicts_total"] = sum(out["verdicts"].values())
+    out["latency_seconds_total"] = sum(out["latency_seconds"].values())
+    out["shed_total"] = sum(out["shed"].values())
+    return out
+
+
+def reset() -> None:
+    global _STATS
+    with _LOCK:
+        _STATS = _zero()

@@ -10,17 +10,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== gate 1/4: pytest =="
+echo "== gate 1/5: verify call-site lint =="
+python scripts/check_verify_callsites.py
+
+echo "== gate 2/5: pytest =="
 python -m pytest tests/ -x -q
 
-echo "== gate 2/4: bench.py =="
+echo "== gate 3/5: bench.py =="
 python bench.py
 
-echo "== gate 3/4: dryrun_multichip(8) =="
+echo "== gate 4/5: dryrun_multichip(8) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== gate 4/4: native sanitizers (TSAN+ASAN) =="
+echo "== gate 5/5: native sanitizers (TSAN+ASAN) =="
 bash scripts/sanitize_native.sh
 
 echo "gate: ALL GREEN"

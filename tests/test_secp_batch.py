@@ -66,6 +66,8 @@ class TestDeviceLadder:
             assert x == int.from_bytes(pub[1:], "big")
             assert (y & 1) == (pub[0] & 1)
 
+    @pytest.mark.slow  # ~30s XLA compile of another ladder shape for a
+    # padding edge case; the seam's device path (TestSeam) stays tier-1
     def test_odd_batch_padding(self):
         _, pubs, msgs, sigs = _fixture(3)
         bits = sv.verify_batch(pubs, msgs, sigs)
