@@ -478,6 +478,245 @@ def run_sched(emit, submitters=8, per_submitter=64, flush_us=None) -> dict:
     return rec
 
 
+def run_txflood(emit, n_txs=384, batch=128, n_pertx=24) -> dict:
+    """Batched tx-admission stage (docs/tx-ingest.md): a flood of signed-
+    envelope txs into an envelope-aware mempool, measured two ways —
+
+      * per-tx: ``mempool.check_tx`` per gossiped tx (today's shape: one
+        app round trip and one coalesced-of-one verify dispatch each);
+      * batched: the ingest coalescer drains the flood through
+        ``check_tx_batch`` — one bulk-class signature pass and one
+        ``check_txs`` app round trip per ``batch`` txs.
+
+    Reports txs/s admitted, app round trips and verify dispatches per 1k
+    txs, and consensus-class p99 submit->verdict latency idle vs during
+    the flood (the flood must never shed or starve consensus).  Emitted
+    as the BENCH_TXFLOOD JSON line (stage="txflood")."""
+    import hashlib
+    import threading
+
+    from cometbft_tpu import verifysched
+    from cometbft_tpu.abci import types as at
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config.config import MempoolConfig
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.crypto import batch as cbatch
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.crypto import keys as ck
+    from cometbft_tpu.crypto import sigcache
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+    from cometbft_tpu.ops import dispatch_stats
+    from cometbft_tpu.ops import verify as ov
+    from cometbft_tpu.proxy.multi_app_conn import (
+        AppConns,
+        local_client_creator,
+    )
+    from cometbft_tpu.txingest import (
+        IngestCoalescer,
+        SigVerifyingApp,
+        sign_tx,
+    )
+    from cometbft_tpu.txingest import stats as istats
+
+    class _CountingConn:
+        """Mempool-connection wrapper counting app round trips."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.round_trips = 0
+
+        def check_tx(self, req):
+            self.round_trips += 1
+            return self.inner.check_tx(req)
+
+        def check_txs(self, reqs):
+            self.round_trips += 1
+            return self.inner.check_txs(reqs)
+
+    def _stack():
+        conns = AppConns(
+            local_client_creator(SigVerifyingApp(KVStoreApplication()))
+        )
+        conns.start()
+        conn = _CountingConn(conns.mempool)
+        return conn, CListMempool(
+            MempoolConfig(recheck=False, size=100_000),
+            conn,
+            envelope_aware=True,
+        )
+
+    privs = [
+        ck.Ed25519PrivKey.from_seed(
+            hashlib.sha256(b"txflood%d" % i).digest()
+        )
+        for i in range(4)
+    ]
+    # distinct payloads everywhere: nothing deduplicates, so the batching
+    # win is a round-trip/dispatch win, not a cache artifact
+    def mk_txs(tag: str, n: int) -> "list[bytes]":
+        return [
+            sign_tx(privs[i % len(privs)], b"%s%d=%d" % (tag.encode(), i, i),
+                    nonce=i)
+            for i in range(n)
+        ]
+
+    pertx_txs = mk_txs("p", n_pertx)
+    flood_txs = mk_txs("b", n_txs)
+    # consensus-class probe items (distinct from everything above)
+    probe_msgs = [b"consensus-probe-%d" % i for i in range(256)]
+    probe_sigs = [privs[0].sign(m) for m in probe_msgs]
+    probe_pub = privs[0].pub_key()
+
+    saved_backend = cbatch._DEFAULT_BACKEND
+    saved_ingest = os.environ.get("COMETBFT_TPU_TXINGEST")
+    cbatch.set_default_backend("tpu")
+    os.environ["COMETBFT_TPU_TXINGEST"] = "1"
+    sigcache.reset_cache()
+    verifysched.reset_scheduler()
+    verifysched.stats.reset()
+    istats.reset()
+    try:
+        # warm every bucket shape either phase can dispatch — per-tx fills
+        # the smallest bucket, the coalesced flush any intermediate one (a
+        # few consensus probe items may ride along) — with the watchdog
+        # off so a cold compile can't open the breaker (run_sched pattern)
+        saved_wd = os.environ.get("COMETBFT_TPU_DISPATCH_TIMEOUT_MS")
+        os.environ["COMETBFT_TPU_DISPATCH_TIMEOUT_MS"] = "0"
+        try:
+            wp = [ref.pubkey_from_seed(b"\x31" * 32)] * (batch + 32)
+            wm = [b"txflood-warm-%d" % i for i in range(batch + 32)]
+            ws = [ref.sign(b"\x31" * 32, m) for m in wm]
+            b = ov.bucket_size(1, ov._min_bucket())
+            while True:
+                k = min(b, len(wp))
+                _retry_unavailable(
+                    lambda k=k: ov.verify_batch(wp[:k], wm[:k], ws[:k])
+                )
+                if b >= len(wp):
+                    break
+                b = ov.bucket_size(b + 1, ov._min_bucket())
+        finally:
+            if saved_wd is None:
+                os.environ.pop("COMETBFT_TPU_DISPATCH_TIMEOUT_MS", None)
+            else:
+                os.environ["COMETBFT_TPU_DISPATCH_TIMEOUT_MS"] = saved_wd
+        backend_health.reset()
+        sigcache.reset_cache()  # warmup verdicts must not feed the phases
+
+        def pctl(xs, q):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+        def consensus_probe(k0: int, n: int) -> "list[float]":
+            lats = []
+            for i in range(k0, k0 + n):
+                t0 = time.perf_counter()
+                ok = verifysched.verify_cached(
+                    probe_pub, probe_msgs[i], probe_sigs[i],
+                    priority=verifysched.PRIO_CONSENSUS,
+                )
+                lats.append(time.perf_counter() - t0)
+                assert ok is True
+            return lats
+
+        # idle consensus latency: the comparison floor for "unharmed"
+        idle_lat = consensus_probe(0, 16)
+
+        # -- per-tx phase -------------------------------------------------
+        conn_a, mp_a = _stack()
+        d0 = dispatch_stats.dispatch_count()
+        t0 = time.perf_counter()
+        for tx in pertx_txs:
+            res = mp_a.check_tx(tx)
+            assert res.ok, res.log
+        pertx_wall = time.perf_counter() - t0
+        pertx_disp = dispatch_stats.dispatch_count() - d0
+        pertx_rt = conn_a.round_trips
+        assert mp_a.size() == n_pertx
+
+        sigcache.reset_cache()  # phase A verdicts must not feed phase B
+
+        # -- batched phase, consensus probes riding alongside --------------
+        conn_b, mp_b = _stack()
+        ing = IngestCoalescer(
+            mp_b, batch_max=batch, queue_cap=n_txs, start_thread=False
+        )
+        flood_lat: "list[float]" = []
+        stop = threading.Event()
+
+        def prober():
+            k = 16
+            while not stop.is_set() and k < len(probe_msgs):
+                flood_lat.extend(consensus_probe(k, 1))
+                k += 1
+
+        sshed0 = verifysched.stats.snapshot()["shed"]["consensus"]
+        th = threading.Thread(target=prober)
+        th.start()
+        d0 = dispatch_stats.dispatch_count()
+        t0 = time.perf_counter()
+        try:
+            for tx in flood_txs:
+                queued = ing.submit(tx)
+                assert queued is None  # queue sized to the flood: no shed
+            ing.flush_now()
+        finally:
+            stop.set()
+            th.join()
+        flood_wall = time.perf_counter() - t0
+        flood_disp = dispatch_stats.dispatch_count() - d0
+        flood_rt = conn_b.round_trips
+        assert mp_b.size() == n_txs
+        if not flood_lat:  # flood outran the first probe (tiny configs)
+            flood_lat = consensus_probe(16, 1)
+        shed_consensus = (
+            verifysched.stats.snapshot()["shed"]["consensus"] - sshed0
+        )
+        assert shed_consensus == 0, shed_consensus
+        isnap = istats.snapshot()
+    finally:
+        verifysched.reset_scheduler()
+        cbatch.set_default_backend(saved_backend)
+        sigcache.reset_cache()
+        istats.reset()
+        if saved_ingest is None:
+            os.environ.pop("COMETBFT_TPU_TXINGEST", None)
+        else:
+            os.environ["COMETBFT_TPU_TXINGEST"] = saved_ingest
+
+    rec = {
+        "metric": "txflood_admission_throughput",
+        "stage": "txflood",
+        "txs": n_txs,
+        "batch": batch,
+        "pertx_txs": n_pertx,
+        "pertx_txs_per_s": round(n_pertx / pertx_wall, 1),
+        "batched_txs_per_s": round(n_txs / flood_wall, 1),
+        "pertx_round_trips_per_1k": round(pertx_rt * 1000 / n_pertx, 1),
+        "batched_round_trips_per_1k": round(flood_rt * 1000 / n_txs, 1),
+        "pertx_dispatches_per_1k": round(pertx_disp * 1000 / n_pertx, 1),
+        "batched_dispatches_per_1k": round(flood_disp * 1000 / n_txs, 1),
+        "round_trip_reduction": round(
+            (pertx_rt / n_pertx) / max(flood_rt / n_txs, 1e-9), 1
+        ),
+        "dispatch_reduction": round(
+            (pertx_disp / max(n_pertx, 1))
+            / max(flood_disp / n_txs, 1e-9),
+            1,
+        ),
+        "consensus_p50_idle_ms": round(pctl(idle_lat, 0.5) * 1e3, 2),
+        "consensus_p99_idle_ms": round(pctl(idle_lat, 0.99) * 1e3, 2),
+        "consensus_p50_flood_ms": round(pctl(flood_lat, 0.5) * 1e3, 2),
+        "consensus_p99_flood_ms": round(pctl(flood_lat, 0.99) * 1e3, 2),
+        "consensus_shed": shed_consensus,
+        "flood_probe_samples": len(flood_lat),
+        "sig_prechecked": isnap["sig_prechecked"],
+        "ingest_occupancy": round(isnap["batch_occupancy"], 4),
+    }
+    emit(rec)
+    return rec
+
+
 def _loopback_cache_hit_rate() -> float:
     """Gossip-verify one round of precommits into a VoteSet, then re-verify
     the commit assembled from them (the apply-time LastCommit check) — the
@@ -622,6 +861,24 @@ def _worker_cpu() -> None:
             _emit(
                 _result_line(
                     "sched-failed", 0.0, dict(partial=True, error=repr(e))
+                )
+            )
+    # batched tx admission (ISSUE 6): the story is round-trips and
+    # dispatches per 1k gossiped txs, honest even on the XLA-CPU kernel
+    if os.environ.get("BENCH_TXFLOOD", "1") != "0":
+        try:
+            run_txflood(
+                lambda rec: _emit(
+                    dict(rec, impl="xla", platform="cpu", partial=True)
+                ),
+                n_txs=int(os.environ.get("BENCH_TXFLOOD_TXS", "256")),
+                batch=int(os.environ.get("BENCH_TXFLOOD_BATCH", "128")),
+                n_pertx=int(os.environ.get("BENCH_TXFLOOD_PERTX", "16")),
+            )
+        except Exception as e:  # noqa: BLE001
+            _emit(
+                _result_line(
+                    "txflood-failed", 0.0, dict(partial=True, error=repr(e))
                 )
             )
     _emit(
@@ -882,6 +1139,31 @@ def worker(platform_mode: str) -> None:
             _emit(
                 _result_line(
                     "sched-failed", 0.0, dict(partial=True, error=repr(e))
+                )
+            )
+
+    # batched tx admission (ISSUE 6): coalesced gossip-burst CheckTx vs
+    # per-tx — round trips and verify dispatches per 1k txs
+    if os.environ.get("BENCH_TXFLOOD", "1") != "0":
+        _emit(
+            _result_line(
+                "compile-txflood", 0.0,
+                dict(impl=impl, platform=platform, partial=True),
+            )
+        )
+        try:
+            run_txflood(
+                lambda rec: _emit(
+                    dict(rec, impl=impl, platform=platform, partial=True)
+                ),
+                n_txs=int(os.environ.get("BENCH_TXFLOOD_TXS", "384")),
+                batch=int(os.environ.get("BENCH_TXFLOOD_BATCH", "128")),
+                n_pertx=int(os.environ.get("BENCH_TXFLOOD_PERTX", "24")),
+            )
+        except Exception as e:  # noqa: BLE001 — never risk the headline
+            _emit(
+                _result_line(
+                    "txflood-failed", 0.0, dict(partial=True, error=repr(e))
                 )
             )
 
@@ -1220,6 +1502,14 @@ def main() -> None:
         "submit->verdict latency); BENCH_SCHED_SUBMITTERS / "
         "BENCH_SCHED_SIGS size the run",
     )
+    ap.add_argument(
+        "--txflood",
+        action="store_true",
+        help="run only the batched tx-admission stage: ingest-coalesced "
+        "check_txs vs per-tx CheckTx (txs/s, app round trips and verify "
+        "dispatches per 1k txs, consensus p99 latency idle vs flood); "
+        "BENCH_TXFLOOD_TXS / _BATCH / _PERTX size the run",
+    )
     args = ap.parse_args()
     for k, v in _CACHE_ENV.items():
         os.environ.setdefault(k, v)
@@ -1264,6 +1554,21 @@ def main() -> None:
             _emit,
             submitters=int(os.environ.get("BENCH_SCHED_SUBMITTERS", "8")),
             per_submitter=int(os.environ.get("BENCH_SCHED_SIGS", "64")),
+        )
+    elif args.txflood:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _CACHE_ENV["JAX_COMPILATION_CACHE_DIR"],
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        run_txflood(
+            _emit,
+            n_txs=int(os.environ.get("BENCH_TXFLOOD_TXS", "384")),
+            batch=int(os.environ.get("BENCH_TXFLOOD_BATCH", "128")),
+            n_pertx=int(os.environ.get("BENCH_TXFLOOD_PERTX", "24")),
         )
     elif args.worker:
         plat = os.environ.get("COMETBFT_TPU_JAX_PLATFORM")

@@ -24,6 +24,15 @@ class Application:
     def check_tx(self, req: at.CheckTxRequest) -> at.CheckTxResponse:
         raise NotImplementedError
 
+    def check_txs(self, req: at.CheckTxsRequest) -> at.CheckTxsResponse:
+        """Batched CheckTx (docs/tx-ingest.md): the default loops over
+        ``check_tx`` so every app supports the batched mempool connection
+        unchanged — overriding is an optimization (e.g. one fused
+        signature dispatch per burst), never a semantic change."""
+        return at.CheckTxsResponse(
+            responses=[self.check_tx(r) for r in req.requests]
+        )
+
     # Consensus connection
     def init_chain(self, req: at.InitChainRequest) -> at.InitChainResponse:
         raise NotImplementedError

@@ -24,6 +24,7 @@ _METHODS = (
     "info",
     "query",
     "check_tx",
+    "check_txs",
     "init_chain",
     "prepare_proposal",
     "process_proposal",
@@ -70,6 +71,42 @@ class Client:
 
     def check_tx(self, req):
         return self.call("check_tx", req)
+
+    def check_txs(
+        self, reqs: "list[at.CheckTxRequest]"
+    ) -> "list[at.CheckTxResponse]":
+        """Batched CheckTx: one round trip for a whole gossip burst
+        (docs/tx-ingest.md).  Falls back to a per-tx loop — and remembers —
+        when the remote end predates the batched method, so callers can
+        always use the batch surface and only the round-trip count varies.
+        """
+        if not reqs:
+            return []
+        if not getattr(self, "_no_check_txs", False):
+            try:
+                resp = self.call("check_txs", at.CheckTxsRequest(requests=reqs))
+            except NotImplementedError:
+                self._no_check_txs = True
+            except AttributeError:
+                app = getattr(self, "app", None)
+                if app is not None and hasattr(app, "check_txs"):
+                    raise  # a genuine bug inside the app's own check_txs
+                # duck-typed app without the method
+                self._no_check_txs = True
+            except ABCIClientError:
+                # remote end predates the batched method (a legacy socket
+                # server errors on the unknown frame): degrade to per-tx
+                # calls — if the connection is actually dead, the per-tx
+                # path surfaces that immediately instead of masking it
+                self._no_check_txs = True
+            else:
+                if len(resp.responses) != len(reqs):
+                    raise ABCIClientError(
+                        "check_txs returned %d responses for %d requests"
+                        % (len(resp.responses), len(reqs))
+                    )
+                return list(resp.responses)
+        return [self.call("check_tx", r) for r in reqs]
 
     def init_chain(self, req):
         return self.call("init_chain", req)
@@ -124,6 +161,23 @@ class LocalClient(Client):
             raise ABCIClientError(f"unknown ABCI method {method}")
         with self.lock:
             return getattr(self.app, method)(req)
+
+    def check_txs(
+        self, reqs: "list[at.CheckTxRequest]"
+    ) -> "list[at.CheckTxResponse]":
+        # An app that overrides check_txs opted into one batched call and
+        # holds the shared four-connection lock for it.  The base-class
+        # loop gains nothing from that — release the lock between txs so
+        # consensus-connection calls can interleave with a gossip burst
+        # (the batch stays a sequence of independent checks either way).
+        from cometbft_tpu.abci.application import Application
+
+        if getattr(type(self.app), "check_txs", None) in (
+            Application.check_txs,
+            None,
+        ):
+            return [self.call("check_tx", r) for r in reqs]
+        return super().check_txs(reqs)
 
     def check_tx_async(self, req, cb):
         cb(self.call("check_tx", req))

@@ -23,6 +23,7 @@ _REQ_TYPES = {
     "info": at.InfoRequest,
     "query": at.QueryRequest,
     "check_tx": at.CheckTxRequest,
+    "check_txs": at.CheckTxsRequest,
     "init_chain": at.InitChainRequest,
     "prepare_proposal": at.PrepareProposalRequest,
     "process_proposal": at.ProcessProposalRequest,
@@ -41,6 +42,7 @@ _RESP_TYPES = {
     "info": at.InfoResponse,
     "query": at.QueryResponse,
     "check_tx": at.CheckTxResponse,
+    "check_txs": at.CheckTxsResponse,
     "init_chain": at.InitChainResponse,
     "prepare_proposal": at.PrepareProposalResponse,
     "process_proposal": at.ProcessProposalResponse,

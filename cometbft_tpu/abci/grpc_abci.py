@@ -537,6 +537,15 @@ class GRPCClient(Client):
         # (mempool CheckTx callbacks) without unbounded threads
         self._pool.submit(lambda: cb(self.call("check_tx", req)))
 
+    def check_txs(
+        self, reqs: "list[at.CheckTxRequest]"
+    ) -> "list[at.CheckTxResponse]":
+        # The gRPC ABCI service mirrors the reference proto, which has no
+        # CheckTxs RPC — batched admission (docs/tx-ingest.md) degrades to
+        # per-tx unary calls here (HTTP/2 pipelines them on one channel);
+        # only the socket and local clients collapse the round trips.
+        return [self.call("check_tx", r) for r in reqs]
+
     def call(self, method: str, req) -> object:
         if method == "info":
             r = self._unary(

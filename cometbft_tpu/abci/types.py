@@ -129,6 +129,13 @@ class InfoResponse:
     last_block_app_hash: bytes = b""
     lane_priorities: dict[str, int] = field(default_factory=dict)
     default_lane: str = ""
+    # True when the app (e.g. wrapped in txingest.SigVerifyingApp) rejects
+    # signed-tx envelopes with bad signatures itself, using the canonical
+    # txingest codes.  The mempool ingest pipeline then pre-verifies
+    # envelope signatures on the crypto seam and rejects forgeries without
+    # an app round trip — byte-identical codes by construction
+    # (docs/tx-ingest.md).
+    envelope_sig_verified: bool = False
 
 
 @dataclass
@@ -178,6 +185,25 @@ class CheckTxResponse:
     @property
     def ok(self) -> bool:
         return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class CheckTxsRequest:
+    """Batched CheckTx: one mempool-connection round trip admits a whole
+    gossip burst (docs/tx-ingest.md).  Apps that don't override
+    ``check_txs`` get the loop-over-``check_tx`` fallback in
+    ``Application``, so the batch is always semantically a sequence of
+    independent per-tx checks — batching changes the round-trip count,
+    never the verdicts."""
+
+    requests: list[CheckTxRequest] = field(default_factory=list)
+
+
+@dataclass
+class CheckTxsResponse:
+    """One response per request, index-aligned."""
+
+    responses: list[CheckTxResponse] = field(default_factory=list)
 
 
 # -- consensus connection ---------------------------------------------------

@@ -21,6 +21,7 @@ from cometbft_tpu.abci import types as at
 from cometbft_tpu.config.config import MempoolConfig
 from cometbft_tpu.crypto import tmhash
 from cometbft_tpu.libs.clist import CElement, CList
+from cometbft_tpu.txingest import stats as ingest_stats
 
 
 class MempoolError(Exception):
@@ -86,6 +87,16 @@ class LRUTxCache:
         with self._mtx:
             return key in self._map
 
+    def touch(self, key: bytes) -> bool:
+        """True if present, refreshing recency — the dedup probe the
+        ingest coalescer runs before taking a queue slot, with the same
+        LRU effect a duplicate gets from ``push`` on the per-tx path."""
+        with self._mtx:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return True
+            return False
+
     def reset(self) -> None:
         with self._mtx:
             self._map.clear()
@@ -99,6 +110,9 @@ class NopTxCache:
         pass
 
     def has(self, key: bytes) -> bool:
+        return False
+
+    def touch(self, key: bytes) -> bool:
         return False
 
     def reset(self) -> None:
@@ -123,11 +137,17 @@ class CListMempool:
         lane_priorities: Optional[dict[str, int]] = None,
         default_lane: str = "",
         pre_check: Optional[Callable[[bytes], Optional[str]]] = None,
+        envelope_aware: bool = False,
     ):
         self.config = config
         self.proxy_app = proxy_app
         self.height = height
         self.pre_check = pre_check
+        # True when the app advertises InfoResponse.envelope_sig_verified:
+        # batched admission may then pre-verify signed-tx envelopes on the
+        # crypto seam and reject forgeries with the app's own canonical
+        # codes before any app round trip (docs/tx-ingest.md)
+        self.envelope_aware = envelope_aware
         self.cache = (
             LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
         )
@@ -190,6 +210,18 @@ class CListMempool:
 
     # -- CheckTx ingress --------------------------------------------------
 
+    @staticmethod
+    def tx_key(tx: bytes) -> bytes:
+        return tmhash.sum256(tx)
+
+    def note_duplicate(self, key: bytes, sender: str) -> None:
+        """Record a gossip duplicate's sender so we don't gossip back
+        (reference :365) — shared by the per-tx path, batched admission
+        and the coalescer's pre-queue dedup."""
+        el = self._tx_map.get(key)
+        if el is not None and sender:
+            el.value.senders.add(sender)
+
     def check_tx(self, tx: bytes, sender: str = "") -> at.CheckTxResponse:
         """Validate and maybe add a tx (reference: clist_mempool.go:333).
 
@@ -198,38 +230,171 @@ class CListMempool:
         duplicate-sender tracking, full checks) are identical.
         """
         if len(tx) > self.config.max_tx_bytes:
+            ingest_stats.record_error("too_large")
             raise TxTooLargeError(
                 f"tx {len(tx)}B > max {self.config.max_tx_bytes}B"
             )
         if self.pre_check is not None:
             err = self.pre_check(tx)
             if err:
+                ingest_stats.record_error("pre_check")
                 raise PreCheckError(err)
 
         key = tmhash.sum256(tx)
         if not self.cache.push(key):
-            # Record the new sender so we don't gossip back (reference :365).
-            el = self._tx_map.get(key)
-            if el is not None and sender:
-                el.value.senders.add(sender)
+            self.note_duplicate(key, sender)
+            ingest_stats.record_cache(True)
+            ingest_stats.record_error("duplicate")
             raise TxInCacheError()
+        ingest_stats.record_cache(False)
 
         if (
             self.size() + 1 > self.config.size
             or self._total_bytes + len(tx) > self.config.max_txs_bytes
         ):
             self.cache.remove(key)
+            ingest_stats.record_error("full")
             raise MempoolFullError(self.size(), self._total_bytes)
 
         res = self.proxy_app.check_tx(at.CheckTxRequest(tx=tx))
         self._handle_check_tx_response(tx, key, sender, res)
         return res
 
+    def check_tx_batch(
+        self,
+        txs: Sequence[bytes],
+        senders: Optional[Sequence[str]] = None,
+        keys: Optional[Sequence[bytes]] = None,
+    ) -> list:
+        """Batched admission (docs/tx-ingest.md): run the per-tx gauntlet
+        (size, pre-check, cache dedup) in request order, pre-verify
+        signed-tx envelopes on the crypto seam when the app is
+        envelope-aware (forgeries rejected with the app's canonical codes,
+        no app round trip), then admit every survivor through ONE batched
+        ``check_txs`` call.  Returns one entry per tx: the
+        ``CheckTxResponse``, or the ``MempoolError`` instance the per-tx
+        path would have raised.  Final mempool contents, tx order, codes
+        and cache state are identical to sequential ``check_tx`` calls —
+        tests/test_txingest.py pins this differentially.
+
+        ``keys`` optionally carries precomputed ``tx_key`` hashes (the
+        coalescer already hashed every tx for its pre-queue dedup probe)
+        so the hot gossip path hashes each tx once, not twice."""
+        from cometbft_tpu.txingest import envelope as ev
+
+        n = len(txs)
+        senders = list(senders) if senders is not None else [""] * n
+        if len(senders) != n:
+            raise ValueError(
+                f"check_tx_batch: {len(senders)} senders for {n} txs"
+            )
+        if keys is not None and len(keys) != n:
+            raise ValueError(
+                f"check_tx_batch: {len(keys)} keys for {n} txs"
+            )
+        pre_keys = keys
+        results: list = [None] * n
+        keys: "list[Optional[bytes]]" = [None] * n
+        live: "list[int]" = []
+        for i, (tx, sender) in enumerate(zip(txs, senders)):
+            if len(tx) > self.config.max_tx_bytes:
+                ingest_stats.record_error("too_large")
+                results[i] = TxTooLargeError(
+                    f"tx {len(tx)}B > max {self.config.max_tx_bytes}B"
+                )
+                continue
+            if self.pre_check is not None:
+                err = self.pre_check(tx)
+                if err:
+                    ingest_stats.record_error("pre_check")
+                    results[i] = PreCheckError(err)
+                    continue
+            key = pre_keys[i] if pre_keys is not None else tmhash.sum256(tx)
+            if not self.cache.push(key):
+                # also dedups duplicates WITHIN the batch: the first
+                # occurrence owns the cache slot, later ones land here
+                # (the apply loop re-probes — see below — in case the
+                # first occurrence is rejected and releases the slot)
+                self.note_duplicate(key, sender)
+                ingest_stats.record_cache(True)
+                ingest_stats.record_error("duplicate")
+                keys[i] = key
+                results[i] = TxInCacheError()
+                continue
+            ingest_stats.record_cache(False)
+            keys[i] = key
+            live.append(i)
+
+        if self.envelope_aware and live:
+            # one bulk-class pass through the verify seam for the whole
+            # burst; a shed inside verify_envelopes degrades to per-item
+            # sync host verify — never a dropped tx verdict
+            envs: "list" = [None] * n
+            for i in live:
+                if ev.is_envelope(txs[i]):
+                    try:
+                        envs[i] = ev.decode(txs[i])
+                    except ev.EnvelopeError as e:
+                        results[i] = ev.reject_bad_envelope(str(e))
+            verdicts = ev.verify_envelopes(envs)
+            n_sigs = sum(1 for e in envs if e is not None)
+            ingest_stats.record_sig_precheck(n_sigs)
+            for i in live:
+                if envs[i] is not None and not verdicts[i]:
+                    results[i] = ev.reject_bad_signature()
+            live = [i for i in live if results[i] is None]
+
+        if live:
+            reqs = [at.CheckTxRequest(tx=txs[i]) for i in live]
+            resps = self._app_check_txs(reqs)
+            ingest_stats.record_app_batch(len(reqs))
+            for i, res in zip(live, resps):
+                results[i] = res
+
+        # apply in request order: the full check and the add/cache
+        # bookkeeping see exactly the mempool state sequential per-tx
+        # admission would have seen
+        for i in range(n):
+            res = results[i]
+            if isinstance(res, TxInCacheError):
+                # the dedup probe ran before any verdict existed; if the
+                # occurrence that owned the cache slot was since rejected
+                # (rejection releases the slot unless
+                # keep_invalid_txs_in_cache), sequential admission would
+                # have re-checked this tx — do that now, per-tx
+                if not self.cache.push(keys[i]):
+                    continue  # genuine duplicate, error stands
+                res = self.proxy_app.check_tx(at.CheckTxRequest(tx=txs[i]))
+                results[i] = res
+            if not isinstance(res, at.CheckTxResponse):
+                continue  # admission error; cache handled above
+            if (
+                self.size() + 1 > self.config.size
+                or self._total_bytes + len(txs[i]) > self.config.max_txs_bytes
+            ):
+                self.cache.remove(keys[i])
+                ingest_stats.record_error("full")
+                results[i] = MempoolFullError(self.size(), self._total_bytes)
+                continue
+            self._handle_check_tx_response(txs[i], keys[i], senders[i], res)
+        return results
+
+    def _app_check_txs(
+        self, reqs: "list[at.CheckTxRequest]"
+    ) -> "list[at.CheckTxResponse]":
+        """One batched round trip when the proxy supports it (all
+        ``abci.client.Client``s do), else the per-tx loop."""
+        ck = getattr(self.proxy_app, "check_txs", None)
+        if ck is None:
+            return [self.proxy_app.check_tx(r) for r in reqs]
+        return ck(reqs)
+
     def _handle_check_tx_response(
         self, tx: bytes, key: bytes, sender: str, res: at.CheckTxResponse
     ) -> None:
         """Reference: clist_mempool.go:393 handleCheckTxResponse."""
         if not res.ok:
+            ingest_stats.record_reject(res.code)
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
             return
@@ -247,6 +412,7 @@ class CListMempool:
             el = self.lanes[lane].push_back(mtx)
             self._tx_map[key] = el
             self._total_bytes += len(tx)
+        ingest_stats.record_admitted()
         self._notify_txs_available()
 
     def _notify_txs_available(self) -> None:
@@ -338,13 +504,31 @@ class CListMempool:
             self._notify_txs_available()
 
     def _recheck_txs(self) -> None:
-        """Re-run CheckTx on all remaining txs (reference: :828 recheckTxs)."""
-        for key, el in list(self._tx_map.items()):
+        """Re-run CheckTx on all remaining txs (reference: :828 recheckTxs).
+
+        With tx ingestion enabled the whole remaining mempool rides ONE
+        batched ``check_txs`` round trip — and, behind an envelope-aware
+        app, one fused signature pass (all cache hits in the common case)
+        — instead of the serial per-tx loop.  ``COMETBFT_TPU_TXINGEST=0``
+        restores the loop; verdicts are identical either way because the
+        batch is semantically a sequence of independent checks."""
+        from cometbft_tpu.txingest.coalescer import ingest_enabled
+
+        items = list(self._tx_map.items())
+        reqs = [
+            at.CheckTxRequest(tx=el.value.tx, type_=at.CHECK_TX_TYPE_RECHECK)
+            for _, el in items
+        ]
+        if ingest_enabled() and len(items) > 1:
+            resps = self._app_check_txs(reqs)
+            ingest_stats.record_app_batch(len(reqs))
+            ingest_stats.record_recheck(len(reqs))
+        else:
+            resps = [self.proxy_app.check_tx(r) for r in reqs]
+        for (key, el), res in zip(items, resps):
             mtx: MempoolTx = el.value
-            res = self.proxy_app.check_tx(
-                at.CheckTxRequest(tx=mtx.tx, type_=at.CHECK_TX_TYPE_RECHECK)
-            )
             if not res.ok:
+                ingest_stats.record_reject(res.code)
                 self._tx_map.pop(key, None)
                 self.lanes[mtx.lane].remove(el)
                 self._total_bytes -= len(mtx.tx)

@@ -11,8 +11,15 @@ from __future__ import annotations
 import threading
 import time
 
+from cometbft_tpu.abci import types as at
 from cometbft_tpu.libs import log as liblog
-from cometbft_tpu.mempool.clist_mempool import MempoolError
+from cometbft_tpu.mempool.clist_mempool import (
+    MempoolError,
+    MempoolFullError,
+    PreCheckError,
+    TxInCacheError,
+    TxTooLargeError,
+)
 from cometbft_tpu.p2p.conn import ChannelDescriptor
 from cometbft_tpu.p2p.reactor import Reactor
 
@@ -21,15 +28,27 @@ _BROADCAST_SLEEP = 0.02
 
 
 class MempoolReactor(Reactor):
-    """Reference: mempool/reactor.go Reactor."""
+    """Reference: mempool/reactor.go Reactor.
 
-    def __init__(self, config, mempool, logger=None):
+    ``ingest`` (a ``txingest.IngestCoalescer``) routes incoming gossip
+    through batched admission when active; without it — or with
+    ``COMETBFT_TPU_TXINGEST=0`` — every tx takes the per-tx ``check_tx``
+    path exactly as before.  Either way the reactor now counts (and logs,
+    at debug) tx-cache dedup hits and CheckTx rejections per peer instead
+    of silently swallowing them: ``peer_ingest_stats`` feeds sim
+    assertions and the ``cometbft_mempool_*`` metrics."""
+
+    def __init__(self, config, mempool, logger=None, ingest=None):
         super().__init__("MempoolReactor")
         self.config = config
         self.mempool = mempool
+        self.ingest = ingest
+        if ingest is not None and ingest.on_result is None:
+            ingest.on_result = self._note_flush_result
         self.logger = logger or liblog.nop_logger()
         self._peer_routines: dict[str, threading.Event] = {}
         self._lock = threading.Lock()
+        self._peer_stats: dict[str, dict[str, int]] = {}
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [
@@ -63,11 +82,66 @@ class MempoolReactor(Reactor):
             stop.set()
 
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
-        """An incoming tx: CheckTx with the peer recorded as sender."""
+        """An incoming tx: CheckTx with the peer recorded as sender —
+        batched through the ingest coalescer when active.  Dupes / full /
+        failed pre-check stay non-fatal, but are now counted per peer."""
         try:
-            self.mempool.check_tx(msg_bytes, sender=peer.id)
-        except MempoolError:
-            pass  # dupes / full / failed pre-check are non-fatal
+            if self.ingest is not None:
+                res = self.ingest.submit(msg_bytes, sender=peer.id)
+            else:
+                res = self.mempool.check_tx(msg_bytes, sender=peer.id)
+        except MempoolError as e:
+            self._note_sync_error(peer.id, e)
+            return
+        if res is not None:  # None = queued; verdict arrives at flush time
+            self._note_response(peer.id, res)
+
+    # -- per-peer ingest accounting ---------------------------------------
+
+    def peer_ingest_stats(self) -> "dict[str, dict[str, int]]":
+        with self._lock:
+            return {p: dict(s) for p, s in self._peer_stats.items()}
+
+    def _bump(self, peer_id: str, kind: str) -> None:
+        with self._lock:
+            stats = self._peer_stats.setdefault(
+                peer_id, {"accepted": 0, "dedup": 0, "rejected": 0, "error": 0}
+            )
+            stats[kind] += 1
+
+    def _note_response(self, peer_id: str, res: at.CheckTxResponse) -> None:
+        if res.ok:
+            self._bump(peer_id, "accepted")
+        else:
+            self._bump(peer_id, "rejected")
+            self.logger.debug(
+                "tx rejected by CheckTx",
+                peer=peer_id,
+                code=res.code,
+                codespace=res.codespace,
+                log=res.log,
+            )
+
+    def _note_sync_error(self, peer_id: str, err: MempoolError) -> None:
+        if isinstance(err, TxInCacheError):
+            self._bump(peer_id, "dedup")
+            self.logger.debug("tx dedup (cache hit)", peer=peer_id)
+        else:
+            self._bump(peer_id, "error")
+            kind = {
+                MempoolFullError: "mempool full",
+                TxTooLargeError: "tx too large",
+                PreCheckError: "pre-check failed",
+            }.get(type(err), "mempool error")
+            self.logger.debug("tx not admitted", peer=peer_id, reason=kind)
+
+    def _note_flush_result(self, peer_id: str, res) -> None:
+        """Flush-time outcome from the coalescer (response or the
+        MempoolError the per-tx path would have raised)."""
+        if isinstance(res, at.CheckTxResponse):
+            self._note_response(peer_id, res)
+        elif isinstance(res, MempoolError):
+            self._note_sync_error(peer_id, res)
 
     def _broadcast_tx_routine(self, peer, stop: threading.Event) -> None:
         """Reference: reactor.go:213 broadcastTxRoutine — iterate the lanes

@@ -194,6 +194,7 @@ class Node(BaseService):
 
         # -- mempool --------------------------------------------------------
         info = self.proxy_app.query.info()
+        self.tx_ingest = None
         if config.mempool.type_ == "nop":
             self.mempool = NopMempool()
         else:
@@ -203,9 +204,15 @@ class Node(BaseService):
                 height=state.last_block_height,
                 lane_priorities=dict(info.lane_priorities),
                 default_lane=info.default_lane,
+                envelope_aware=getattr(info, "envelope_sig_verified", False),
             )
             if not config.consensus.create_empty_blocks:
                 self.mempool.enable_txs_available()
+            # batched gossip admission (docs/tx-ingest.md); inert until
+            # COMETBFT_TPU_TXINGEST + the trusted-backend gate activate it
+            from cometbft_tpu.txingest import IngestCoalescer
+
+            self.tx_ingest = IngestCoalescer(self.mempool)
 
         # -- block executor -------------------------------------------------
         self.block_exec = BlockExecutor(
@@ -279,6 +286,8 @@ class Node(BaseService):
                     last_height = height
                 if hasattr(self.mempool, "size"):
                     m.mempool_size.set(self.mempool.size())
+                if hasattr(self.mempool, "size_bytes"):
+                    m.mempool_size_bytes.set(self.mempool.size_bytes())
                 if self.switch is not None:
                     m.peers.set(len(self.switch.peers_list()))
             except Exception:  # noqa: BLE001 — metrics must never kill the node
@@ -375,6 +384,7 @@ class Node(BaseService):
                 config.mempool,
                 self.mempool,
                 logger=self.logger.with_(module="mempool-reactor"),
+                ingest=self.tx_ingest,
             )
             self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.evidence_reactor = EvidenceReactor(
@@ -569,6 +579,9 @@ class Node(BaseService):
     def on_stop(self) -> None:
         if self.switch is not None:
             self.switch.stop()
+        if self.tx_ingest is not None:
+            # drain queued gossip into the mempool before the proxy closes
+            self.tx_ingest.close()
         self.consensus.stop()
         if self.rpc_server is not None:
             self.rpc_server.stop()

@@ -5,6 +5,11 @@ four logical connections (consensus, mempool, query, snapshot) so a slow
 query can never head-of-line-block consensus.  For the builtin (in-process)
 app all four share one lock (reference local client semantics); for a socket
 app each is a separate TCP/unix connection.
+
+The mempool connection additionally carries the batched-CheckTx surface
+(``Client.check_txs``, docs/tx-ingest.md): the ingest coalescer admits a
+whole gossip burst in one round trip, with a per-tx loop fallback for
+clients/apps that predate the batch method.
 """
 
 from __future__ import annotations

@@ -78,11 +78,15 @@ class SimCluster:
         raise_on_violation: bool = True,
         check_wal: bool = True,
         catchup: bool = True,
+        app_factory=None,
+        mempool_config=None,
     ):
         self.n_vals = n_vals
         self.root = Path(root)
         self.seed = seed
         self.config = config or sim_consensus_config()
+        self.app_factory = app_factory
+        self.mempool_config = mempool_config
         self.raise_on_violation = raise_on_violation
         self.clock = VirtualClock()
         self.rng = random.Random(seed)
@@ -122,6 +126,8 @@ class SimCluster:
                 self.clock, tock, name=f"node{i}"
             ),
             threaded=False,
+            app_factory=self.app_factory,
+            mempool_config=self.mempool_config,
         )
         self._dbs[i] = node.block_store._db
         node.cs.broadcast_hook = lambda msg, i=i: self.net.send(i, msg)

@@ -96,11 +96,16 @@ def build_node(
     clock: Optional[Callable[[], float]] = None,
     ticker_factory: Optional[Callable] = None,
     threaded: bool = True,
+    app_factory: Optional[Callable] = None,
+    mempool_config: Optional[MempoolConfig] = None,
 ) -> NodeHandle:
     """Assemble one validator under ``root/node{index}``.
 
     ``db`` defaults to a fresh ``MemKV``; pass the previous instance (plus
     the same ``root``) to model a crash-restart from persisted stores.
+    ``app_factory`` overrides the default kvstore app — the tx-flood
+    scenario wraps it in ``txingest.SigVerifyingApp`` so signed-envelope
+    traffic exercises the batched admission pipeline.
     """
     config = config or sim_consensus_config()
     home = root / f"node{index}"
@@ -109,7 +114,7 @@ def build_node(
     block_store = BlockStore(db)
     state_store = StateStore(db)
 
-    app = KVStoreApplication()
+    app = app_factory() if app_factory is not None else KVStoreApplication()
     conns = AppConns(local_client_creator(app))
     conns.start()
 
@@ -123,11 +128,12 @@ def build_node(
 
     info = conns.query.info()
     mempool = CListMempool(
-        MempoolConfig(recheck=False),
+        mempool_config or MempoolConfig(recheck=False),
         conns.mempool,
         height=state.last_block_height,
         lane_priorities=dict(info.lane_priorities),
         default_lane=info.default_lane,
+        envelope_aware=getattr(info, "envelope_sig_verified", False),
     )
     block_exec = BlockExecutor(
         state_store,

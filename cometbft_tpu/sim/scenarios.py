@@ -22,6 +22,11 @@ Built-ins:
   * ``gossip-burst``       — vote storm + bulk-class submission bursts
     overload the verification scheduler's bounded queue; only bulk items
     may shed, consensus votes never, agreement must hold
+  * ``tx-flood``           — sustained scripted signed-tx bursts (valid /
+    forged / malformed / oversize / duplicate mixes) against a small
+    ingest-coalescer queue (docs/tx-ingest.md); batched admission must
+    shed only to the per-tx sync path, consensus-class verify shed stays
+    0, agreement holds, traces byte-identical per seed
 
 The backend-* scenarios force the supervised device verify path
 (``COMETBFT_TPU_CRYPTO_BACKEND=tpu`` — verdict-equal on CPU hosts via the
@@ -44,6 +49,7 @@ from dataclasses import dataclass, field, replace
 from pathlib import Path
 from typing import Callable, Optional
 
+from cometbft_tpu.config.config import MempoolConfig
 from cometbft_tpu.ops import supervisor
 from cometbft_tpu.sim.cluster import SimCluster
 
@@ -70,6 +76,10 @@ class Scenario:
     # restored there)
     setup: Optional[Callable[[SimCluster], None]] = None
     teardown: Optional[Callable[[SimCluster], None]] = None
+    # per-node app/mempool overrides (tx-flood wraps the kvstore in the
+    # SigVerifyingApp middleware and turns recheck on)
+    app_factory: Optional[Callable] = None
+    mempool_config: Optional[object] = None
 
 
 @dataclass
@@ -92,6 +102,9 @@ class ScenarioResult:
     # verify-scheduler counters captured at end-of-run (scenarios that
     # force the tpu backend): submitted/shed per class, flushes, dedup…
     sched: dict = field(default_factory=dict)
+    # tx-ingestion counters captured at end-of-run (tx-flood): enqueued,
+    # shed_to_sync, flushes, batch occupancy, cache hits, rejections…
+    ingest: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -116,6 +129,22 @@ class ScenarioResult:
                 "shed": self.sched["shed"],
                 "flushes": self.sched["flushes"],
                 "dedup_hits": self.sched["dedup_hits"],
+            }
+        if self.ingest:
+            row["ingest"] = {
+                k: self.ingest[k]
+                for k in (
+                    "enqueued",
+                    "shed_to_sync",
+                    "flushes",
+                    "batch_occupancy",
+                    "cache_hits",
+                    "admitted",
+                    "rejected_total",
+                    "app_batches",
+                    "sig_prechecked",
+                    "recheck_batches",
+                )
             }
         return row
 
@@ -185,6 +214,10 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_VERIFY_SCHED",
     "COMETBFT_TPU_SCHED_FLUSH_US",
     "COMETBFT_TPU_SCHED_QUEUE",
+    "COMETBFT_TPU_TXINGEST",
+    "COMETBFT_TPU_TXINGEST_QUEUE",
+    "COMETBFT_TPU_TXINGEST_BATCH",
+    "COMETBFT_TPU_TXINGEST_FLUSH_US",
 )
 
 
@@ -424,6 +457,158 @@ def _gossip_burst(s: Scenario) -> list[Action]:
     ]
 
 
+def _txflood_app():
+    """Envelope-verifying kvstore: signature checks hoisted onto the
+    crypto seam, payloads (``key=value``) served by the stock app."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.txingest import SigVerifyingApp
+
+    return SigVerifyingApp(KVStoreApplication())
+
+
+def _tx_flood_setup(cluster: SimCluster) -> None:
+    from cometbft_tpu.txingest import stats as istats
+
+    _backend_faults_setup(
+        {
+            # apply-time re-checks (process-proposal, finalize, recheck)
+            # must resolve from cache — that's the pipeline under test
+            "COMETBFT_TPU_SIGCACHE": "1",
+            "COMETBFT_TPU_VERIFY_SCHED": "1",
+            "COMETBFT_TPU_SCHED_FLUSH_US": "500",
+            "COMETBFT_TPU_TXINGEST": "1",
+            # a queue far smaller than the burst: most of each burst must
+            # shed to the per-tx sync path and STILL reach a verdict
+            "COMETBFT_TPU_TXINGEST_QUEUE": "32",
+            "COMETBFT_TPU_TXINGEST_BATCH": "24",
+        }
+    )(cluster)
+    istats.reset()
+
+
+def _tx_flood_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu.txingest import stats as istats
+
+    _backend_faults_teardown(cluster)
+    istats.reset()
+
+
+def _tx_flood(s: Scenario) -> list[Action]:
+    """Sustained signed-tx bursts against every node's mempool through a
+    deterministically-driven ingest coalescer (docs/tx-ingest.md).  Each
+    burst mixes valid ed25519/secp256k1 envelopes, forged signatures,
+    malformed envelopes, an oversize tx, in-burst duplicates and re-sends
+    of burst 0 (cross-burst duplicates, incl. committed txs).  The
+    coalescer queue (32 slots, scenario-shrunk) is far smaller than the
+    burst, so most submissions shed to the per-tx sync path — a shed
+    costs the batching win, never a verdict.  Every count logged into the
+    byte-compared trace is a function of verdicts and the seeded
+    submission order only, never of flush timing."""
+
+    def burst(c: SimCluster, burst_no: int) -> None:
+        from cometbft_tpu.abci import types as at
+        from cometbft_tpu.crypto import keys as ck
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+        from cometbft_tpu.mempool.clist_mempool import (
+            MempoolError,
+            TxInCacheError,
+        )
+        from cometbft_tpu.txingest import IngestCoalescer
+        from cometbft_tpu.txingest import envelope as ev
+
+        privs = [
+            ck.Ed25519PrivKey.from_seed(bytes([0x20 + i]) * 32)
+            for i in range(3)
+        ]
+        secp = Secp256k1PrivKey.from_secret(b"\x41" * 32)
+
+        def valid(b: int, i: int) -> bytes:
+            return ev.sign_tx(
+                privs[i % len(privs)], b"f%d_%d=%d" % (b, i, i), nonce=i
+            )
+
+        txs: "list[bytes]" = [valid(burst_no, i) for i in range(36)]
+        txs.append(
+            ev.sign_tx(secp, b"s%d=%d" % (burst_no, burst_no), nonce=burst_no)
+        )
+        # forged: structurally valid envelope, signature from a different
+        # preimage (nonce bumped after signing)
+        for i in range(4):
+            g = ev.decode(txs[i])
+            txs.append(
+                ev.encode(
+                    ev.Envelope(
+                        g.key_type, g.pubkey, g.nonce + 100, g.payload,
+                        g.signature,
+                    )
+                )
+            )
+        # malformed: envelope magic, garbage structure
+        for i in range(3):
+            txs.append(ev.MAGIC + b"\x7fgarbage-%d-%d" % (burst_no, i))
+        # oversize: past the scenario mempool's 2048-byte max_tx_bytes
+        txs.append(
+            ev.sign_tx(privs[0], b"big%d=" % burst_no + b"x" * 4096, nonce=99)
+        )
+        # in-burst duplicates (same bytes twice before any flush) plus,
+        # after burst 0, re-sends of burst 0's first txs — cross-burst
+        # duplicates that are by then cached and possibly committed
+        txs += [valid(burst_no, 0), valid(burst_no, 1)]
+        if burst_no > 0:
+            txs += [valid(0, 0), valid(0, 1)]
+        c.rng.shuffle(txs)
+
+        for i, node in enumerate(c.live_nodes()):
+            outcomes = {"ok": 0, "rejected": 0, "errors": 0}
+
+            def note(sender, res, o=outcomes):
+                if isinstance(res, at.CheckTxResponse):
+                    o["ok" if res.ok else "rejected"] += 1
+                else:
+                    o["errors"] += 1
+
+            ing = IngestCoalescer(
+                node.mempool, start_thread=False, on_result=note
+            )
+            queued = dedup = synced = 0
+            for tx in txs:
+                try:
+                    res = ing.submit(tx, sender="flood")
+                except TxInCacheError:
+                    dedup += 1
+                    continue
+                except MempoolError:
+                    outcomes["errors"] += 1
+                    synced += 1
+                    continue
+                if res is None:
+                    queued += 1
+                else:
+                    synced += 1
+                    note("flood", res)
+            ing.flush_now()
+            c._log(
+                "scenario: tx-flood burst %d node%d: queued=%d shed_sync=%d "
+                "dedup=%d ok=%d rejected=%d errors=%d"
+                % (
+                    burst_no,
+                    i,
+                    queued,
+                    synced,
+                    dedup,
+                    outcomes["ok"],
+                    outcomes["rejected"],
+                    outcomes["errors"],
+                )
+            )
+
+    return [
+        Action(float(t), "signed-tx flood burst %d" % b,
+               lambda c, b=b: burst(c, b))
+        for b, t in enumerate((2, 4, 6, 8))
+    ]
+
+
 def _message_storm(s: Scenario) -> list[Action]:
     def inject_txs(c: SimCluster) -> None:
         h = c.live_nodes()[0].cs.rs.height
@@ -506,6 +691,25 @@ SCENARIOS: dict[str, Scenario] = {
             teardown=_backend_faults_teardown,
         ),
         Scenario(
+            "tx-flood",
+            "sustained scripted signed-tx bursts (valid/forged/malformed/"
+            "oversize/duplicate mixes) from every peer against a 32-slot "
+            "ingest queue: batched admission must produce the same "
+            "verdicts as the per-tx path, shed only to the sync path, "
+            "keep consensus-class verify shed at 0 and agreement intact.  "
+            "Runs on the host-oracle device-runner seam so tier-1 never "
+            "pays real XLA dispatches",
+            target_height=6,
+            max_time=240.0,
+            actions=_tx_flood,
+            setup=_tx_flood_setup,
+            teardown=_tx_flood_teardown,
+            app_factory=_txflood_app,
+            # recheck=True so every commit exercises the batched recheck
+            # round trip; the small max_tx_bytes makes oversize txs cheap
+            mempool_config=MempoolConfig(recheck=True, max_tx_bytes=2048),
+        ),
+        Scenario(
             "backend-brownout",
             "device crypto backend raises on every dispatch on f+1 nodes "
             "from t=5 to t=10; supervisor degrades to host verify, keeps "
@@ -582,7 +786,12 @@ def run_scenario(
     if created_root:
         root = Path(tempfile.mkdtemp(prefix=f"sim-{name}-{seed}-"))
     cluster = SimCluster(
-        scenario.n_vals, root, seed=seed, raise_on_violation=raise_on_violation
+        scenario.n_vals,
+        root,
+        seed=seed,
+        raise_on_violation=raise_on_violation,
+        app_factory=scenario.app_factory,
+        mempool_config=scenario.mempool_config,
     )
     for src_dst, overrides in scenario.link_overrides.items():
         cluster.net.set_link(*src_dst, **overrides)
@@ -594,6 +803,7 @@ def run_scenario(
         )
     backend_stats: dict = {}
     sched_stats: dict = {}
+    ingest_counters: dict = {}
     try:
         if scenario.setup is not None:
             scenario.setup(cluster)
@@ -625,6 +835,13 @@ def run_scenario(
                 from cometbft_tpu.verifysched import stats as sstats
 
                 sched_stats = sstats.snapshot()
+            # tx-ingestion counters (tx-flood): only when the pipeline
+            # actually ran — an all-zero block would read as "ran, idle"
+            from cometbft_tpu.txingest import stats as istats
+
+            isnap = istats.snapshot()
+            if isnap["enqueued"] or isnap["shed_to_sync"] or isnap["flushes"]:
+                ingest_counters = isnap
     finally:
         if scenario.teardown is not None:
             scenario.teardown(cluster)
@@ -646,4 +863,5 @@ def run_scenario(
         cluster=cluster if keep_cluster else None,
         backend=backend_stats,
         sched=sched_stats,
+        ingest=ingest_counters,
     )

@@ -1,0 +1,50 @@
+"""Batched transaction ingestion (docs/tx-ingest.md).
+
+The first *user-facing* workload on the crypto seam: signed-tx envelopes
+(``envelope``), the ``SigVerifyingApp`` ABCI middleware that hoists tx
+signature checks out of applications (``middleware``), and the ingest
+coalescer that admits whole gossip bursts through one batched CheckTx
+round trip with envelope signatures verified as the verifysched bulk
+class (``coalescer``).  ``stats`` holds the jax-free process-wide
+counters ``libs/metrics`` exposes as ``cometbft_mempool_*``.
+
+Kill switch: ``COMETBFT_TPU_TXINGEST=0`` restores the per-tx
+``check_tx`` admission path bit-for-bit.
+"""
+
+from cometbft_tpu.txingest import envelope, stats
+from cometbft_tpu.txingest.coalescer import (
+    IngestCoalescer,
+    ingest_enabled,
+    ingest_active,
+)
+from cometbft_tpu.txingest.envelope import (
+    CODE_BAD_ENVELOPE,
+    CODE_BAD_SIGNATURE,
+    CODESPACE,
+    Envelope,
+    EnvelopeError,
+    decode,
+    encode,
+    is_envelope,
+    sign_tx,
+)
+from cometbft_tpu.txingest.middleware import SigVerifyingApp
+
+__all__ = [
+    "CODE_BAD_ENVELOPE",
+    "CODE_BAD_SIGNATURE",
+    "CODESPACE",
+    "Envelope",
+    "EnvelopeError",
+    "IngestCoalescer",
+    "SigVerifyingApp",
+    "decode",
+    "encode",
+    "envelope",
+    "ingest_active",
+    "ingest_enabled",
+    "is_envelope",
+    "sign_tx",
+    "stats",
+]

@@ -1,0 +1,237 @@
+"""Ingest coalescer: gossip burst -> one batched CheckTx round trip.
+
+The mempool reactor hands every incoming gossip tx to ``submit``; a
+flusher drains the bounded queue when the oldest tx has waited
+``COMETBFT_TPU_TXINGEST_FLUSH_US`` (default 5000) or when
+``COMETBFT_TPU_TXINGEST_BATCH`` (default 256) txs are pending, and
+admits the whole batch through ``CListMempool.check_tx_batch`` — cache
+dedup before any queue slot, envelope signatures verified as the
+verifysched BULK class, one ``check_txs`` app round trip for the
+survivors (docs/tx-ingest.md).
+
+Degradation is always to the per-tx synchronous path, never to a dropped
+verdict: a full ingest queue (``COMETBFT_TPU_TXINGEST_QUEUE``, default
+4096) sheds the submission to ``mempool.check_tx``; the kill switch
+``COMETBFT_TPU_TXINGEST=0`` disables the pipeline entirely, restoring
+per-tx admission bit-for-bit.  Activation additionally gates on the same
+trusted-backend check as the verification scheduler: a CPU-backend node
+has no dispatch floor to amortize, so it keeps today's synchronous
+behavior untouched.
+
+Thread model: a daemon flusher thread in production
+(``start_thread=True``); the deterministic simulator builds coalescers
+with ``start_thread=False`` and drives ``flush_now`` explicitly from
+scripted virtual-time actions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from cometbft_tpu.txingest import stats
+
+logger = logging.getLogger("cometbft_tpu.txingest")
+
+DEFAULT_BATCH = 256
+DEFAULT_FLUSH_US = 5000.0
+DEFAULT_QUEUE_CAP = 4096
+
+
+def ingest_enabled() -> bool:
+    return os.environ.get("COMETBFT_TPU_TXINGEST", "1") != "0"
+
+
+def ingest_active() -> bool:
+    """Kill switch on AND the accelerator batch backend trusted — the
+    scheduler's own gate (never triggers the jax auto-probe from a
+    gossip-path check)."""
+    from cometbft_tpu.verifysched import backend_trusted
+
+    return ingest_enabled() and backend_trusted()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class IngestCoalescer:
+    """Bounded ingest queue + deadline/size flusher over one mempool."""
+
+    def __init__(
+        self,
+        mempool,
+        batch_max: Optional[int] = None,
+        flush_us: Optional[float] = None,
+        queue_cap: Optional[int] = None,
+        start_thread: bool = True,
+        on_result: Optional[Callable[[str, object], None]] = None,
+    ):
+        self.mempool = mempool
+        self.batch_max = max(
+            1,
+            batch_max
+            if batch_max is not None
+            else _env_int("COMETBFT_TPU_TXINGEST_BATCH", DEFAULT_BATCH),
+        )
+        self.flush_s = (
+            max(
+                0.0,
+                flush_us
+                if flush_us is not None
+                else _env_float("COMETBFT_TPU_TXINGEST_FLUSH_US", DEFAULT_FLUSH_US),
+            )
+            / 1e6
+        )
+        self.queue_cap = max(
+            1,
+            queue_cap
+            if queue_cap is not None
+            else _env_int("COMETBFT_TPU_TXINGEST_QUEUE", DEFAULT_QUEUE_CAP),
+        )
+        # flush-time outcome callback: (sender, CheckTxResponse-or-
+        # MempoolError) — the reactor uses it for per-peer accounting
+        self.on_result = on_result
+        self._cond = threading.Condition()
+        self._q: "deque[tuple[bytes, str, float]]" = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._start_thread = start_thread
+
+    # -- submission ---------------------------------------------------------
+
+    def active(self) -> bool:
+        return ingest_active()
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def submit(self, tx: bytes, sender: str = ""):
+        """Queue one gossiped tx for batched admission.
+
+        Returns ``None`` when queued (verdict arrives at flush time via
+        ``on_result``) or the ``CheckTxResponse`` when the tx took a
+        synchronous path (pipeline inactive, or shed by the queue bound).
+        Raises the same ``MempoolError`` family as ``check_tx`` for
+        synchronous rejections — including ``TxInCacheError`` for the
+        pre-queue cache dedup, which is the common gossip-duplicate case
+        and costs neither a queue slot nor an app call."""
+        from cometbft_tpu.mempool.clist_mempool import TxInCacheError
+
+        if not self.active():
+            return self.mempool.check_tx(tx, sender=sender)
+        # dedup BEFORE taking a queue slot, with the same recency refresh
+        # cache.push gives duplicates on the per-tx path
+        key = self.mempool.tx_key(tx)
+        if self.mempool.cache.touch(key):
+            self.mempool.note_duplicate(key, sender)
+            stats.record_cache(True)
+            stats.record_error("duplicate")
+            raise TxInCacheError()
+        with self._cond:
+            if not self._stopped and len(self._q) < self.queue_cap:
+                # the key rides along so flush-time admission doesn't
+                # hash the tx a second time
+                self._q.append((tx, sender, key, time.perf_counter()))
+                stats.record_enqueue()
+                if self._start_thread and (
+                    self._thread is None or not self._thread.is_alive()
+                ):
+                    self._thread = threading.Thread(
+                        target=self._run, name="tx-ingest", daemon=True
+                    )
+                    self._thread.start()
+                self._cond.notify_all()
+                return None
+        # queue full (or closing): shed to the per-tx synchronous path —
+        # shedding costs the batching win, never a tx verdict
+        stats.record_shed_sync()
+        return self.mempool.check_tx(tx, sender=sender)
+
+    # -- flushing -----------------------------------------------------------
+
+    def flush_now(self) -> int:
+        """Drain everything queued, in batch_max chunks.  Synchronous —
+        the simulator's deterministic drive path, and the thread's flush
+        body."""
+        total = 0
+        while True:
+            with self._cond:
+                if not self._q:
+                    return total
+                items = [
+                    self._q.popleft()
+                    for _ in range(min(self.batch_max, len(self._q)))
+                ]
+            self._flush_chunk(items)
+            total += len(items)
+
+    def _flush_chunk(self, items) -> None:
+        txs = [tx for tx, _, _, _ in items]
+        senders = [sender for _, sender, _, _ in items]
+        keys = [key for _, _, key, _ in items]
+        stats.record_flush(len(items), self.batch_max)
+        try:
+            results = self.mempool.check_tx_batch(txs, senders, keys=keys)
+        except Exception:  # noqa: BLE001 — the flusher must survive
+            logger.exception(
+                "batched admission failed; re-admitting %d txs per-tx",
+                len(txs),
+            )
+            results = []
+            for tx, sender in zip(txs, senders):
+                try:
+                    results.append(self.mempool.check_tx(tx, sender=sender))
+                except Exception as e:  # noqa: BLE001 — MempoolError family
+                    results.append(e)
+        if self.on_result is not None:
+            for sender, res in zip(senders, results):
+                try:
+                    self.on_result(sender, res)
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and not self._q:
+                    self._cond.wait()
+                if self._stopped and not self._q:
+                    return
+                while not self._stopped and len(self._q) < self.batch_max:
+                    oldest = self._q[0][3] if self._q else None
+                    if oldest is None:
+                        break
+                    remain = oldest + self.flush_s - time.perf_counter()
+                    if remain <= 0:
+                        break
+                    self._cond.wait(remain)
+                if not self._q:
+                    continue
+            self.flush_now()
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop accepting queued work and drain what's left — a tx handed
+        to the coalescer always reaches the mempool exactly once."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout_s)
+        self.flush_now()

@@ -17,6 +17,7 @@ from cometbft_tpu.verifysched.service import (  # noqa: F401
     PRIO_MEMPOOL,
     QueueFullError,
     VerifyScheduler,
+    backend_trusted,
     current_priority,
     enabled,
     get_scheduler,

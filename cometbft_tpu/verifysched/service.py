@@ -86,28 +86,32 @@ def enabled() -> bool:
     return os.environ.get("COMETBFT_TPU_VERIFY_SCHED", "1") != "0"
 
 
-def scheduler_active() -> bool:
-    """True when submissions should take the scheduler path: kill switch on
-    AND the accelerator batch backend trusted — the same ``tpu`` gate the
-    fused stream and blocksync prefetch use, so a CPU-backend node (whose
-    host library path has no dispatch floor to amortize) keeps today's
+def backend_trusted() -> bool:
+    """True when the accelerator batch backend is the trusted ``tpu``
+    seam — the gate the fused stream, blocksync prefetch, the scheduler
+    AND the tx-ingest coalescer all share, so a CPU-backend node (whose
+    host library path has no dispatch floor to amortize) keeps its
     synchronous behavior untouched.
 
     Deliberately NEVER calls ``cbatch.default_backend()``'s auto-probe:
     that would import jax and initialize a backend from gossip-time
     ``Vote.verify`` in processes that otherwise never touch the device
     (every CPU e2e node pays seconds of init on its first vote).  With the
-    backend unconfigured and still unresolved, the scheduler stays off; it
-    activates the moment the batch seam's own first use resolves the
-    backend to ``tpu``."""
-    if not enabled():
-        return False
+    backend unconfigured and still unresolved, the gate stays closed; it
+    opens the moment the batch seam's own first use resolves the backend
+    to ``tpu``."""
     from cometbft_tpu.crypto import batch as cbatch
 
     env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
     if env and env != "auto":
         return env == "tpu"
     return cbatch._DEFAULT_BACKEND == "tpu"
+
+
+def scheduler_active() -> bool:
+    """True when submissions should take the scheduler path: kill switch
+    on AND the batch backend trusted (``backend_trusted``)."""
+    return enabled() and backend_trusted()
 
 
 # -- per-thread priority class ----------------------------------------------

@@ -13,6 +13,9 @@ gate fails on any DIRECT call site of those functions in production code
   * ``cometbft_tpu/verifysched/`` — the scheduler itself;
   * ``cometbft_tpu/crypto/batch.py`` — the BatchVerifier seam (it bridges
     to the scheduler when active and is the sanctioned fallback);
+  * ``cometbft_tpu/txingest/`` — batched tx admission submits whole
+    gossip bursts through the scheduler's bulk class
+    (``envelope.verify_envelopes``; docs/tx-ingest.md);
 
 plus a PINNED allowlist of pre-scheduler legacy sites (each justified in
 docs/verify-scheduler.md).  Growing a legacy file's call-site count — or
@@ -39,6 +42,11 @@ ALLOWED_DIRS = (
     "cometbft_tpu/ops",
     "cometbft_tpu/verifysched",
     "cometbft_tpu/parallel",  # mesh-sharded analogue lives below the seam
+    # txingest rides the scheduler (envelope.verify_envelopes submits the
+    # whole burst as the PRIO_MEMPOOL bulk class); its shed fallback is
+    # allowed to dispatch one supervised batch directly, mirroring
+    # verifysched.verify_segment_sync (docs/tx-ingest.md)
+    "cometbft_tpu/txingest",
 )
 ALLOWED_FILES = ("cometbft_tpu/crypto/batch.py",)
 

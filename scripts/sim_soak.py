@@ -73,6 +73,17 @@ def main() -> int:
                     backend.get("watchdog_fires", 0),
                     backend.get("breaker_opens", 0),
                 )
+            ingest = row.get("ingest") or {}
+            if ingest:
+                # tx-flood: admission shape is the at-a-glance verdict —
+                # batched occupancy, sync sheds, dedup hits, rejections
+                extra += " adm=%d shed=%d dedup=%d rej=%d occ=%.2f" % (
+                    ingest.get("admitted", 0),
+                    ingest.get("shed_to_sync", 0),
+                    ingest.get("cache_hits", 0),
+                    ingest.get("rejected_total", 0),
+                    ingest.get("batch_occupancy", 0.0),
+                )
             print(
                 "%-20s seed=%-4d %s heights=%s events=%d%s"
                 % (
