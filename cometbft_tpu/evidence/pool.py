@@ -10,10 +10,12 @@ pool.go processConsensusBuffer).
 
 from __future__ import annotations
 
+import os
 import struct
 import threading
 from typing import Optional
 
+from cometbft_tpu.evidence import stats as evstats
 from cometbft_tpu.evidence import verify as everify
 from cometbft_tpu.evidence.verify import EvidenceInvalidError
 from cometbft_tpu.libs import log as liblog
@@ -27,6 +29,19 @@ from cometbft_tpu.types.vote import Vote
 _PENDING = b"evp/"
 _COMMITTED = b"evc/"
 
+# Pending-pool size bounds: a duplicate-vote flood must degrade to drops,
+# never to unbounded memory.  The age bound (consensus evidence params) is
+# enforced by _prune_expired on every committed block, as before.
+DEFAULT_MAX_PENDING = 1024
+DEFAULT_MAX_PENDING_BYTES = 2 * 1024 * 1024
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
 
 def _key(prefix: bytes, height: int, hash_: bytes) -> bytes:
     return prefix + struct.pack(">q", height) + hash_
@@ -35,13 +50,41 @@ def _key(prefix: bytes, height: int, hash_: bytes) -> bytes:
 class EvidencePool:
     """Reference: internal/evidence/pool.go:24 Pool."""
 
-    def __init__(self, db, state_store, block_store, logger=None):
+    def __init__(
+        self,
+        db,
+        state_store,
+        block_store,
+        logger=None,
+        max_pending: Optional[int] = None,
+        max_pending_bytes: Optional[int] = None,
+    ):
         self._db = db
         self.state_store = state_store
         self.block_store = block_store
         self.logger = logger or liblog.nop_logger()
+        self.max_pending = (
+            max_pending
+            if max_pending is not None
+            else _env_int("COMETBFT_TPU_EVIDENCE_POOL_MAX", DEFAULT_MAX_PENDING)
+        )
+        self.max_pending_bytes = (
+            max_pending_bytes
+            if max_pending_bytes is not None
+            else _env_int(
+                "COMETBFT_TPU_EVIDENCE_POOL_MAX_BYTES",
+                DEFAULT_MAX_PENDING_BYTES,
+            )
+        )
         self._mtx = threading.Lock()
         self.state = state_store.load()
+        # pending occupancy, maintained incrementally (seeded by one scan so
+        # a restart against a persisted db starts from the truth)
+        self._pending_count = 0
+        self._pending_bytes = 0
+        for _k, raw in self._db.iterate(_PENDING, _PENDING + b"\xff"):
+            self._pending_count += 1
+            self._pending_bytes += len(raw)
         # consensus-reported vote pairs awaiting state to attribute power
         self._consensus_buffer: list[tuple[Vote, Vote]] = []
         # evidence added since last query, for the gossip reactor
@@ -51,16 +94,44 @@ class EvidencePool:
 
     def add_evidence(self, ev) -> None:
         """Verify and admit evidence from a peer or RPC (reference:
-        pool.go:190 AddEvidence)."""
+        pool.go:190 AddEvidence).  Identical evidence dedups before any
+        signature work; a verified piece arriving at a full pool is
+        DROPPED (counted, logged) rather than growing the pool without
+        bound — a flood costs drops, never memory."""
         with self._mtx:
             if self._is_pending(ev) or self._is_committed(ev):
+                evstats.record("dedup")
                 return  # already have it
             if self.state is None:
                 raise EvidenceError("pool has no state yet")
-            everify.verify(ev, self.state, self.state_store, self.block_store)
-            self._add_pending(ev)
-            self.logger.info("added evidence", evidence=str(ev))
-            self.evidence_waiter.set()
+            try:
+                everify.verify(
+                    ev, self.state, self.state_store, self.block_store
+                )
+            except EvidenceError:
+                evstats.record("rejected")
+                raise
+            self._admit_locked(ev)
+
+    def _admit_locked(self, ev) -> bool:
+        """Bound-checked admission of VERIFIED evidence (mtx held): a full
+        pool drops (counted, logged) instead of growing without bound."""
+        if (
+            self._pending_count >= self.max_pending
+            or self._pending_bytes >= self.max_pending_bytes
+        ):
+            evstats.record("dropped")
+            self.logger.info(
+                "evidence pool full, dropping",
+                evidence=str(ev),
+                depth=self._pending_count,
+            )
+            return False
+        self._add_pending(ev)
+        evstats.record("added")
+        self.logger.info("added evidence", evidence=str(ev))
+        self.evidence_waiter.set()
+        return True
 
     def report_conflicting_votes(self, vote_a: Vote, vote_b: Vote) -> None:
         """Called by consensus on equivocation (reference: pool.go:145
@@ -110,6 +181,8 @@ class EvidencePool:
             self.state = state
             for ev in block_evidence:
                 self._mark_committed(ev)
+            if block_evidence:
+                evstats.record("committed", len(block_evidence))
             self._process_consensus_buffer(state)
             self._prune_expired(state)
 
@@ -143,13 +216,15 @@ class EvidencePool:
                     "failed to verify consensus-reported evidence", err=str(e)
                 )
                 continue
-            self._add_pending(ev)
-            self.logger.info("equivocation evidence created", evidence=str(ev))
-            self.evidence_waiter.set()
+            if self._admit_locked(ev):
+                self.logger.info(
+                    "equivocation evidence created", evidence=str(ev)
+                )
 
     def _prune_expired(self, state) -> None:
         params = state.consensus_params.evidence
         dels = []
+        pruned = 0
         for k, raw in self._db.iterate(_PENDING, _PENDING + b"\xff"):
             height = struct.unpack(">q", k[len(_PENDING) : len(_PENDING) + 8])[0]
             ev = codec.decode_evidence(raw)
@@ -160,6 +235,9 @@ class EvidencePool:
                 and age_ns > params.max_age_duration_ns
             ):
                 dels.append(k)
+                pruned += 1
+                self._pending_count -= 1
+                self._pending_bytes -= len(raw)
         # committed markers only record height; once past the height-age
         # window no duplicate can be re-proposed, so the marker can go too
         for k, _raw in self._db.iterate(_COMMITTED, _COMMITTED + b"\xff"):
@@ -168,11 +246,21 @@ class EvidencePool:
                 dels.append(k)
         for k in dels:
             self._db.delete(k)
+        if pruned:
+            evstats.record("pruned", pruned)
+        self._publish_depth()
 
     # -- storage helpers ---------------------------------------------------
 
+    def _publish_depth(self) -> None:
+        evstats.set_depth(self._pending_count, self._pending_bytes)
+
     def _add_pending(self, ev) -> None:
-        self._db.set(_key(_PENDING, ev.height, ev.hash()), codec.encode_evidence(ev))
+        raw = codec.encode_evidence(ev)
+        self._db.set(_key(_PENDING, ev.height, ev.hash()), raw)
+        self._pending_count += 1
+        self._pending_bytes += len(raw)
+        self._publish_depth()
 
     def _is_pending(self, ev) -> bool:
         return self._db.get(_key(_PENDING, ev.height, ev.hash())) is not None
@@ -182,9 +270,20 @@ class EvidencePool:
 
     def _mark_committed(self, ev) -> None:
         self._db.set(_key(_COMMITTED, ev.height, ev.hash()), b"\x01")
-        self._db.delete(_key(_PENDING, ev.height, ev.hash()))
+        key = _key(_PENDING, ev.height, ev.hash())
+        raw = self._db.get(key)
+        if raw is not None:
+            self._pending_count -= 1
+            self._pending_bytes -= len(raw)
+            self._db.delete(key)
+            self._publish_depth()
 
     # -- introspection -----------------------------------------------------
+
+    def occupancy(self) -> tuple[int, int]:
+        """(pending entries, pending bytes) — sim assertions and metrics."""
+        with self._mtx:
+            return self._pending_count, self._pending_bytes
 
     def all_pending(self) -> list:
         evs, _ = self.pending_evidence(-1)

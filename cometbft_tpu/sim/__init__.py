@@ -2,22 +2,25 @@
 
 FoundationDB-style discipline: an N-validator cluster runs entirely in one
 thread on *virtual* time.  Every source of scheduling nondeterminism — link
-delays, drops, duplicates, reordering, partitions, crashes, consensus
+delays, drops, duplicates, reordering, partitions, crashes, churn
+(statesync joins, graceful leaves), validator-set rotation, consensus
 timeouts — flows through one seeded ``random.Random`` and one event heap
 (``VirtualClock``), so a failing run reproduces byte-identically from its
-seed.  Invariant checkers (agreement / validity / WAL replay) run after
-every delivered event.
+seed.  Invariant checkers (agreement / validity / validator-set / WAL
+replay) run after every delivered event, verifying commits against the
+height-correct validator set across rotations.
 
 Entry points:
   * ``SimCluster``   — assemble and drive a cluster programmatically
   * ``run_scenario`` — named fault scripts (``cometbft-tpu sim`` CLI)
+  * ``compose``      — merge fault scripts into combined-fault scenarios
 """
 
 from cometbft_tpu.sim.clock import SimTicker, VirtualClock
 from cometbft_tpu.sim.cluster import SimCluster
 from cometbft_tpu.sim.invariants import InvariantChecker, InvariantViolation
 from cometbft_tpu.sim.network import LinkConfig, SimNetwork
-from cometbft_tpu.sim.scenarios import SCENARIOS, run_scenario
+from cometbft_tpu.sim.scenarios import SCENARIOS, compose, run_scenario
 
 __all__ = [
     "SCENARIOS",
@@ -28,5 +31,6 @@ __all__ = [
     "SimNetwork",
     "SimTicker",
     "VirtualClock",
+    "compose",
     "run_scenario",
 ]

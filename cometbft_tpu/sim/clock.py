@@ -69,6 +69,17 @@ class VirtualClock:
     def pending(self) -> int:
         return sum(1 for t in self._heap if not t.cancelled)
 
+    def advance_to(self, when: float) -> None:
+        """Advance idle time WITHOUT firing events.  Callers must ensure no
+        pending event is scheduled before ``when`` (the statesync sleeper
+        drains those through ``tick`` first); time never moves backwards."""
+        nxt = self.next_event_time()
+        if nxt is not None and nxt < when:
+            raise ValueError(
+                f"advance_to({when}) would skip an event at {nxt}"
+            )
+        self._now = max(self._now, float(when))
+
     def next_event_time(self) -> Optional[float]:
         self._drop_cancelled()
         return self._heap[0].when if self._heap else None

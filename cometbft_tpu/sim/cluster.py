@@ -13,11 +13,23 @@ runs a virtual-time catchup tick modelled on the reactor's
 ``gossipDataForCatchup``: a lagging node is served the seen-commit votes and
 block parts for its current height by the lowest-indexed connected peer that
 has them, through the same faulty fabric as everything else.
+
+Fleet-scale membership (docs/sim-design.md "Fleet scale"): the cluster can
+be built with ``n_spares`` standby nodes beyond the genesis validator set.
+A spare either comes online at genesis (``spawn_spare`` — it replays the
+chain through consensus catchup) or arrives later via the REAL statesync
+path (``join`` — snapshot offer → chunk fetch over the faulty fabric →
+blocksync-style catchup tail), all on the virtual clock.  ``leave`` retires
+a node gracefully; ``crash``/``restart`` stay the hard-kill path.  The
+validator set itself rotates through ``val:`` txs (``add_validator`` /
+``remove_validator``), which flow through the production
+``validate_validator_updates`` path at FinalizeBlock.
 """
 
 from __future__ import annotations
 
 import random
+import shutil
 from pathlib import Path
 from typing import Optional
 
@@ -31,6 +43,7 @@ from cometbft_tpu.sim.clock import SimTicker, VirtualClock
 from cometbft_tpu.sim.invariants import InvariantChecker
 from cometbft_tpu.sim.network import SimNetwork
 from cometbft_tpu.sim.node import (
+    HandleProvider,
     NodeHandle,
     build_node,
     make_genesis,
@@ -80,8 +93,11 @@ class SimCluster:
         catchup: bool = True,
         app_factory=None,
         mempool_config=None,
+        n_spares: int = 0,
     ):
         self.n_vals = n_vals
+        self.n_spares = n_spares
+        self.n_nodes = n_vals + n_spares
         self.root = Path(root)
         self.seed = seed
         self.config = config or sim_consensus_config()
@@ -90,12 +106,14 @@ class SimCluster:
         self.raise_on_violation = raise_on_violation
         self.clock = VirtualClock()
         self.rng = random.Random(seed)
-        self.privs, self.gdoc = make_genesis(n_vals, SIM_CHAIN_ID)
-        self.net = SimNetwork(self.clock, self.rng, n_vals)
+        self.privs, self.gdoc = make_genesis(
+            n_vals, SIM_CHAIN_ID, n_nodes=self.n_nodes
+        )
+        self.net = SimNetwork(self.clock, self.rng, self.n_nodes)
         self.net.deliver_fn = self._on_deliver
         self.net.alive_fn = lambda i: self.nodes[i] is not None
         self.checker = InvariantChecker(
-            SIM_CHAIN_ID, state_from_genesis(self.gdoc).validators, check_wal
+            SIM_CHAIN_ID, state_from_genesis(self.gdoc), check_wal
         )
         self.trace: list[str] = []
         self.events_fired = 0
@@ -104,16 +122,21 @@ class SimCluster:
         # device failures to a victim subset; None = cluster-level work
         # (invariant checker, scripted actions).
         self.active_node: Optional[int] = None
-        self._dbs: list = [None] * n_vals  # MemKV survives crash-restart
+        self._dbs: list = [None] * self.n_nodes  # MemKV survives crash-restart
         self.nodes: list[Optional[NodeHandle]] = [
             self._build(i) for i in range(n_vals)
-        ]
+        ] + [None] * n_spares
+        # membership: nodes expected to be online and at the chain head;
+        # ``reached`` waits for exactly these (a crashed member still
+        # counts as behind, a left node does not)
+        self.members: set[int] = set(range(n_vals))
         self._started = False
         self._catchup = catchup
+        self._joining = False  # statesync joins never nest
 
     # -- assembly ----------------------------------------------------------
 
-    def _build(self, i: int) -> NodeHandle:
+    def _build(self, i: int, app=None, app_conns=None) -> NodeHandle:
         node = build_node(
             i,
             self.privs[i],
@@ -128,6 +151,8 @@ class SimCluster:
             threaded=False,
             app_factory=self.app_factory,
             mempool_config=self.mempool_config,
+            app=app,
+            app_conns=app_conns,
         )
         self._dbs[i] = node.block_store._db
         node.cs.broadcast_hook = lambda msg, i=i: self.net.send(i, msg)
@@ -183,6 +208,225 @@ class SimCluster:
         self._drain_all()
         self.checker.on_restart(self, i)
 
+    # -- churn --------------------------------------------------------------
+
+    def leave(self, i: int) -> None:
+        """Graceful departure: the node flushes and stops (WAL intact) and
+        stops counting toward ``reached``.  Its stores survive, so a later
+        ``restart`` models an operator bringing the same node back, while
+        ``join`` models a fresh machine taking over the index."""
+        node = self.nodes[i]
+        if node is None:
+            return
+        self._log("leave node%d" % i)
+        self.nodes[i] = None
+        node.cs.stop()
+        node.app_conns.stop()
+        self.members.discard(i)
+
+    def spawn_spare(self, i: int) -> None:
+        """Bring standby node ``i`` online from genesis: it replays the
+        whole chain through consensus catchup (cheap early in a run).  Late
+        arrivals should use ``join`` instead — that's the statesync path."""
+        if self.nodes[i] is not None:
+            return
+        self._log("spawn node%d" % i)
+        node = self._build(i)
+        self.nodes[i] = node
+        self.members.add(i)
+        node.cs.start()
+        self._drain_all()
+
+    def join(self, i: int, helper_index: Optional[int] = None) -> bool:
+        """Bring node ``i`` online as a FRESH machine via statesync on the
+        virtual clock: discover snapshots from live peers, light-verify the
+        target height against a height-1 trust root, stream chunks through
+        the faulty fabric, bootstrap the stores, then let the catchup tick
+        serve the blocksync tail.  Returns False (and logs) when no viable
+        snapshot exists yet — scenarios typically retry a few virtual
+        seconds later.  Any previous identity at this index is wiped."""
+        if self.nodes[i] is not None or self._joining:
+            return False
+        helpers = [
+            n
+            for n in self.live_nodes()
+            if helper_index is None or n.index == helper_index
+        ]
+        if not helpers:
+            self._log("join node%d failed: no live peers" % i)
+            return False
+        self._log("join node%d starting statesync" % i)
+        self._joining = True
+        try:
+            ok = self._statesync_join(i, helpers)
+        finally:
+            self._joining = False
+        return ok
+
+    def _statesync_join(self, i: int, helpers: list[NodeHandle]) -> bool:
+        from cometbft_tpu.abci import types as at
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.light.verifier import TrustOptions
+        from cometbft_tpu.proxy.multi_app_conn import (
+            AppConns,
+            local_client_creator,
+        )
+        from cometbft_tpu.statesync.stateprovider import (
+            LightClientStateProvider,
+        )
+        from cometbft_tpu.statesync.syncer import (
+            SnapshotKey,
+            StatesyncError,
+            Syncer,
+        )
+        from cometbft_tpu.state.store import StateStore
+        from cometbft_tpu.store.block_store import BlockStore
+        from cometbft_tpu.store.kv import MemKV
+
+        # fresh machine: no stores, no WAL, no privval history
+        shutil.rmtree(self.root / f"node{i}", ignore_errors=True)
+        self._dbs[i] = None
+        app = (
+            self.app_factory() if self.app_factory is not None
+            else KVStoreApplication()
+        )
+        conns = AppConns(local_client_creator(app))
+        conns.start()
+
+        trust_meta = helpers[0].block_store.load_block_meta(1)
+        if trust_meta is None:
+            conns.stop()
+            self._log("join node%d failed: no trust root yet" % i)
+            return False
+        provider = HandleProvider(helpers[0], SIM_CHAIN_ID)
+        state_provider = LightClientStateProvider(
+            SIM_CHAIN_ID,
+            [provider],
+            TrustOptions(
+                period_s=10**9,
+                height=1,
+                hash=trust_meta.block_id.hash,
+            ),
+            genesis_doc=self.gdoc,
+            now_fn=self.clock,
+        )
+
+        syncer_box: list = []
+
+        def request_chunk(peer_id: str, height: int, fmt: int, idx: int) -> bool:
+            src = int(peer_id[len("node"):])
+
+            def respond() -> None:
+                peer = self.nodes[src]
+                if peer is None:
+                    return  # helper died between request and response
+                res = peer.app_conns.snapshot.load_snapshot_chunk(
+                    at.LoadSnapshotChunkRequest(
+                        height=height, format=fmt, chunk=idx
+                    )
+                )
+                if res.chunk and syncer_box:
+
+                    def deliver() -> None:
+                        syncer_box[0].add_chunk(height, fmt, idx, res.chunk)
+
+                    self.net.schedule_transfer(
+                        src, i, deliver, label="chunk-resp"
+                    )
+
+            # request leg and response leg each cross the faulty fabric
+            return self.net.schedule_transfer(
+                i, src, respond, label="chunk-req"
+            )
+
+        syncer = Syncer(
+            state_provider,
+            conns,
+            request_chunk,
+            logger=None,
+            clock=self.clock,
+            sleeper=self._statesync_sleeper,
+        )
+        syncer_box.append(syncer)
+
+        # snapshot discovery: every live helper advertises its app snapshots
+        for helper in helpers:
+            res = helper.app_conns.snapshot.list_snapshots(
+                at.ListSnapshotsRequest()
+            )
+            for s in res.snapshots:
+                syncer.add_snapshot(
+                    f"node{helper.index}",
+                    SnapshotKey(
+                        height=s.height,
+                        format=s.format,
+                        hash=s.hash,
+                        chunks=s.chunks,
+                        metadata=s.metadata,
+                    ),
+                )
+
+        try:
+            state, commit = syncer.sync_any(0.0, is_running=lambda: True)
+        except StatesyncError as e:
+            conns.stop()
+            self._log("join node%d statesync failed: %s" % (i, e))
+            return False
+
+        db = MemKV()
+        StateStore(db).bootstrap(state)
+        BlockStore(db).save_seen_commit(state.last_block_height, commit)
+        self._dbs[i] = db
+        node = self._build(i, app=app, app_conns=conns)
+        self.nodes[i] = node
+        self.members.add(i)
+        self.checker.on_join(self, i, state.last_block_height)
+        self._log(
+            "join node%d statesync complete h=%d" % (i, state.last_block_height)
+        )
+        node.cs.start()
+        self._drain_all()
+        return True
+
+    def _statesync_sleeper(self, timeout: float) -> None:
+        """The syncer's wait seam on virtual time: keep the REST of the
+        cluster running (consensus timeouts, deliveries, scripted faults,
+        chunk responses) while the joiner blocks, exactly like a real
+        joiner waiting out the network."""
+        deadline = self.clock.now() + timeout
+        while True:
+            nxt = self.clock.next_event_time()
+            if nxt is None or nxt > deadline:
+                break
+            self.step()
+        self.clock.advance_to(deadline)
+
+    # -- validator rotation -------------------------------------------------
+
+    def add_validator(self, i: int, power: int = 10) -> None:
+        """Vote node ``i``'s key into the validator set: inject the
+        kvstore's ``val:`` tx into every live mempool so whichever node
+        proposes next carries the update (validate_validator_updates path,
+        effective at +2 heights)."""
+        self._inject_val_tx(i, power)
+
+    def remove_validator(self, i: int) -> None:
+        """Vote node ``i`` out (power 0 removes; the node keeps running as
+        a full node)."""
+        self._inject_val_tx(i, 0)
+
+    def _inject_val_tx(self, i: int, power: int) -> None:
+        import base64
+
+        pub_b64 = base64.b64encode(self.privs[i].pub_key().bytes()).decode()
+        tx = b"val:%s!%d" % (pub_b64.encode(), power)
+        self._log("validator update node%d power=%d" % (i, power))
+        for node in self.live_nodes():
+            try:
+                node.mempool.check_tx(tx)
+            except Exception:  # noqa: BLE001 — duplicate in cache etc.
+                pass
+
     # -- event loop --------------------------------------------------------
 
     def _on_deliver(self, dst: int, src: int, msg) -> None:
@@ -229,7 +473,7 @@ class SimCluster:
         max_time: float = 600.0,
         max_events: int = 500_000,
     ) -> bool:
-        """Drive until every live node has committed ``until_height`` (or
+        """Drive until every member node has committed ``until_height`` (or
         the virtual-time/event budget runs out).  Returns success."""
         self.start()
         while True:
@@ -241,11 +485,12 @@ class SimCluster:
                 return until_height is not None and self.reached(until_height)
 
     def reached(self, height: int) -> bool:
-        """Every validator — crashed ones count as behind — has committed
-        ``height``; 'the cluster made it' means no node left behind."""
+        """Every member — crashed ones count as behind — has committed
+        ``height``; 'the cluster made it' means no member left behind."""
         return all(
-            n is not None and n.block_store.height() >= height
-            for n in self.nodes
+            self.nodes[i] is not None
+            and self.nodes[i].block_store.height() >= height
+            for i in self.members
         )
 
     def heights(self) -> list[int]:

@@ -7,19 +7,31 @@ delivered message costs O(new commits), not O(history):
   * **agreement** — no two nodes ever commit different blocks at one height
     (the first committed hash per height is the canonical one).
   * **validity** — every stored seen-commit carries +2/3 valid signatures
-    from the genesis validator set, checked through the production
+    from the *height-correct* validator set, checked through the production
     ``verify_commit`` path (and therefore the BatchVerifier seam).
+  * **validator-set** — the checker replays validator-set evolution itself
+    (genesis set + the ``validator_updates`` each committed block's
+    finalize response carries, through the production
+    ``validate_validator_updates`` path) and requires every header's
+    ``validators_hash`` / ``next_validators_hash`` to match the tracked
+    sets.  Since the header hash is what the commit signs, this chains
+    custody light-client-style: a rotation can only be accepted if the
+    previous height's (+2/3-signed) header committed to it.
   * **wal-replay** — the fsync'd ``#ENDHEIGHT h`` marker exists for every
-    height the node committed, so a crash after this point replays
-    deterministically; on restart the rebuilt state must agree with the
-    stores it was rebuilt from.
+    height the node committed *through consensus*, so a crash after this
+    point replays deterministically; on restart the rebuilt state must
+    agree with the stores it was rebuilt from.  Heights below a node's
+    block-store base (obtained via statesync, not consensus) are exempt.
+
+Limitation: consensus-param updates are not replayed (no sim scenario
+issues them); ``validate_validator_updates`` runs against genesis params.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
-from cometbft_tpu.types.validation import CommitVerificationError, verify_commit
+from cometbft_tpu.types.validation import verify_commit
 
 
 class InvariantViolation(AssertionError):
@@ -40,17 +52,29 @@ class Violation:
 
 
 class InvariantChecker:
-    def __init__(self, chain_id: str, validators, check_wal: bool = True):
+    def __init__(self, chain_id: str, genesis_state, check_wal: bool = True):
         self.chain_id = chain_id
-        self.validators = validators  # genesis ValidatorSet (no updates in sim)
+        self.consensus_params = genesis_state.consensus_params
+        initial = genesis_state.initial_height
+        # canonical validator set per height, advanced as blocks commit:
+        # vals[h+2] = update(vals[h+1], updates-from-block-h), exactly the
+        # state/execution.go updateState schedule
+        self.val_sets = {
+            initial: genesis_state.validators.copy(),
+            initial + 1: genesis_state.next_validators.copy(),
+        }
+        self.val_updates: dict[int, list] = {}  # height -> canonical updates
         self.check_wal = check_wal
         self.canonical: dict[int, bytes] = {}  # height -> first committed hash
         self._checked: dict[int, int] = {}  # node index -> last verified height
         # incremental WAL readers: node -> (byte offset, end-heights seen);
         # keeps the per-event WAL check O(new bytes), not O(log) per height
         self._wal_tail: dict[int, tuple[int, set]] = {}
+        # node index -> heights at/below this arrived via statesync (no WAL)
+        self._wal_floor: dict[int, int] = {}
         self.violations: list[Violation] = []
         self.commits_verified = 0
+        self.rotations_seen = 0  # heights whose canonical updates were non-empty
 
     # -- driver hooks ------------------------------------------------------
 
@@ -72,25 +96,82 @@ class InvariantChecker:
         state = node.state_store.load()
         store_h = node.block_store.height()
         state_h = state.last_block_height if state is not None else -1
-        if state_h != store_h:
+        # a statesync joiner restarted before its first post-join commit
+        # has state at the snapshot height but an empty block store; every
+        # other node must agree exactly
+        floor = self._wal_floor.get(index, 0)
+        expect_h = max(store_h, floor)
+        if state_h != expect_h:
             self._violate(
                 cluster,
                 "wal-replay",
                 f"node{index} restarted with state height {state_h} != "
-                f"block store height {store_h}",
+                f"block store height {expect_h}",
             )
         # the consensus state must resume at the next height
-        if node.cs.rs.height != store_h + 1 and store_h > 0:
+        if node.cs.rs.height != expect_h + 1:
             self._violate(
                 cluster,
                 "wal-replay",
                 f"node{index} consensus resumed at {node.cs.rs.height}, "
-                f"store at {store_h}",
+                f"store at {expect_h}",
             )
-        # re-verification of already-committed heights must still pass
-        self._checked[index] = 0
+        # re-verification of already-committed heights must still pass —
+        # from the node's base, not genesis (a statesync joiner never held
+        # the pre-snapshot blocks)
+        base = node.block_store.base()
+        self._checked[index] = max(0, base - 1, floor)
+
+    def on_join(self, cluster, index: int, base_height: int) -> None:
+        """A node bootstrapped via statesync at ``base_height``: its first
+        consensus-made commit is base_height+1, and nothing below it exists
+        in its stores or WAL."""
+        self._checked[index] = base_height
+        self._wal_floor[index] = base_height
+        self._wal_tail.pop(index, None)
 
     # -- checks ------------------------------------------------------------
+
+    def _vals_at(self, h: int):
+        return self.val_sets.get(h)
+
+    def _advance_val_sets(self, cluster, node, h: int) -> None:
+        """Record height h's canonical validator updates (first node to
+        commit h wins) and derive the set for h+2, mirroring
+        state/execution updateState.  The derived set is authenticated one
+        height later, when header h+1's next_validators_hash is checked."""
+        if h in self.val_updates or (h + 2) in self.val_sets:
+            return
+        raw = node.state_store.load_finalize_block_response(h)
+        if raw is None:
+            return  # another node will supply it when it commits h
+        from cometbft_tpu.state.execution import (
+            fbr_from_json,
+            validate_validator_updates,
+        )
+
+        base = self._vals_at(h + 1)
+        if base is None:
+            return
+        res = fbr_from_json(raw)
+        try:
+            updates = validate_validator_updates(
+                res.validator_updates, self.consensus_params
+            )
+        except Exception as e:  # noqa: BLE001 — an invalid committed update
+            self._violate(
+                cluster,
+                "validator-set",
+                f"height {h} committed invalid validator updates: {e!r}",
+            )
+            return
+        self.val_updates[h] = updates
+        nxt = base.copy()
+        if updates:
+            nxt.update_with_change_set(updates)
+            self.rotations_seen += 1
+        nxt.increment_proposer_priority(1)
+        self.val_sets[h + 2] = nxt
 
     def _check_height(self, cluster, node, h: int) -> list[str]:
         meta = node.block_store.load_block_meta(h)
@@ -114,6 +195,31 @@ class InvariantChecker:
                 f"{block_hash.hex()[:16]}, canonical is {canonical.hex()[:16]}",
             )
 
+        vals = self._vals_at(h)
+        if vals is None:
+            self._violate(
+                cluster,
+                "validator-set",
+                f"node{node.index} committed height {h} but the canonical "
+                f"validator set for it is unknown (tracking hole)",
+            )
+        else:
+            if meta.header.validators_hash != vals.hash():
+                self._violate(
+                    cluster,
+                    "validator-set",
+                    f"node{node.index} height {h} header validators_hash "
+                    f"does not match the tracked set",
+                )
+            nxt = self._vals_at(h + 1)
+            if nxt is not None and meta.header.next_validators_hash != nxt.hash():
+                self._violate(
+                    cluster,
+                    "validator-set",
+                    f"node{node.index} height {h} header "
+                    f"next_validators_hash does not match the tracked set",
+                )
+
         commit = node.block_store.load_seen_commit(h)
         if commit is None:
             self._violate(
@@ -121,11 +227,11 @@ class InvariantChecker:
                 "validity",
                 f"node{node.index} stored height {h} without a seen commit",
             )
-        else:
+        elif vals is not None:
             try:
                 verify_commit(
                     self.chain_id,
-                    self.validators,
+                    vals,
                     meta.block_id,
                     h,
                     commit,
@@ -139,7 +245,13 @@ class InvariantChecker:
                     f"node{node.index} height {h} commit rejected: {e!r}",
                 )
 
-        if self.check_wal and node.cs.wal is not None:
+        self._advance_val_sets(cluster, node, h)
+
+        if (
+            self.check_wal
+            and node.cs.wal is not None
+            and h > self._wal_floor.get(node.index, 0)
+        ):
             if h not in self._wal_ends(node):
                 self._violate(
                     cluster,

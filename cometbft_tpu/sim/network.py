@@ -76,6 +76,14 @@ class SimNetwork:
         for cfg in self.links.values():
             cfg.update(**overrides)
 
+    def set_node_links(self, i: int, **overrides) -> None:
+        """Apply overrides to every link touching node ``i`` (both
+        directions) — the statesync-storm scenario degrades a joiner's
+        connectivity without touching the rest of the fabric."""
+        for (src, dst), cfg in self.links.items():
+            if src == i or dst == i:
+                cfg.update(**overrides)
+
     def partition(self, *groups: list[int]) -> None:
         """Split the cluster into the given groups; nodes not named form one
         implicit remainder group.  Replaces any existing partition."""
@@ -131,6 +139,40 @@ class SimNetwork:
                 lambda s=src, d=dst, m=msg: self._deliver(s, d, m),
                 label=f"net {src}->{dst}",
             )
+
+    def schedule_transfer(
+        self, src: int, dst: int, fn: Callable[[], None], label: str = "xfer"
+    ) -> bool:
+        """Schedule an arbitrary point-to-point delivery callback through
+        the same faulty link as consensus traffic (delay/drop/partition;
+        duplication is meaningless for idempotent transfers and skipped).
+        Statesync snapshot/chunk responses ride this, so a lossy or
+        partitioned link starves a bootstrapping joiner exactly like it
+        starves gossip.  Returns False when the transfer was dropped.
+
+        Unlike ``_schedule`` the delivery does NOT consult ``alive_fn`` —
+        a joiner mid-bootstrap is not in the cluster's node table yet."""
+        cfg = self.links[(src, dst)]
+        self.stats.sent += 1
+        if not self.connected(src, dst):
+            self.stats.dropped_partition += 1
+            return False
+        if cfg.drop_rate > 0.0 and self.rng.random() < cfg.drop_rate:
+            self.stats.dropped_rate += 1
+            return False
+        delay = self.rng.uniform(cfg.delay_min, cfg.delay_max)
+        if cfg.reorder_rate > 0.0 and self.rng.random() < cfg.reorder_rate:
+            delay += self.rng.uniform(0.0, cfg.reorder_jitter)
+
+        def deliver() -> None:
+            if not self.connected(src, dst):
+                self.stats.dropped_partition += 1
+                return
+            self.stats.delivered += 1
+            fn()
+
+        self.clock.call_later(delay, deliver, label=f"net {label} {src}->{dst}")
+        return True
 
     def _deliver(self, src: int, dst: int, msg: object) -> None:
         if not self.connected(src, dst):

@@ -25,6 +25,7 @@ from cometbft_tpu.consensus.replay import Handshaker
 from cometbft_tpu.consensus.state import ConsensusState
 from cometbft_tpu.consensus.wal import WAL
 from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.evidence.pool import EvidencePool
 from cometbft_tpu.mempool.clist_mempool import CListMempool
 from cometbft_tpu.privval.file_pv import FilePV
 from cometbft_tpu.proxy.multi_app_conn import AppConns, local_client_creator
@@ -51,6 +52,7 @@ class NodeHandle:
     state_store: StateStore
     event_bus: EventBus
     priv_val: FilePV
+    evidence_pool: EvidencePool
 
 
 def sim_consensus_config(**overrides) -> ConsensusConfig:
@@ -71,19 +73,70 @@ def sim_consensus_config(**overrides) -> ConsensusConfig:
 
 
 def make_genesis(
-    n_vals: int, chain_id: str, seed_tag: bytes = b"netval%d"
+    n_vals: int,
+    chain_id: str,
+    seed_tag: bytes = b"netval%d",
+    n_nodes: Optional[int] = None,
 ) -> tuple[list[Ed25519PrivKey], GenesisDoc]:
-    """N deterministic validator keys + a genesis doc naming them."""
+    """Deterministic validator keys + a genesis doc naming the first
+    ``n_vals`` of them.  ``n_nodes`` (>= n_vals) mints extra keys for
+    standby full nodes — churn/rotation scenarios later join them via
+    statesync and vote them in with ``val:`` txs."""
     privs = [
         Ed25519PrivKey.from_seed(hashlib.sha256(seed_tag % i).digest())
-        for i in range(n_vals)
+        for i in range(max(n_vals, n_nodes or 0))
     ]
     gdoc = GenesisDoc(
         chain_id=chain_id,
         genesis_time=Timestamp(0, 0),
-        validators=[GenesisValidator(p.pub_key(), 10) for p in privs],
+        validators=[
+            GenesisValidator(p.pub_key(), 10) for p in privs[:n_vals]
+        ],
     )
     return privs, gdoc
+
+
+class HandleProvider:
+    """Light-block provider over a live ``NodeHandle`` (the in-process
+    analog of ``light.provider.NodeProvider``): a statesync joiner's light
+    client reads headers/commits/validator sets straight from a helper
+    peer's stores, so snapshot trust verification runs the production
+    light-client path on the virtual clock."""
+
+    def __init__(self, handle: "NodeHandle", chain_id: str):
+        self.handle = handle
+        self._chain_id = chain_id
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def id(self) -> str:
+        return f"simnode:{self.handle.index}"
+
+    def light_block(self, height: int):
+        from cometbft_tpu.light.provider import ErrLightBlockNotFound
+        from cometbft_tpu.types.light import LightBlock, SignedHeader
+
+        bs = self.handle.block_store
+        h = height or bs.height()
+        meta = bs.load_block_meta(h)
+        commit = bs.load_block_commit(h) or bs.load_seen_commit(h)
+        vals = self.handle.state_store.load_validators(h)
+        if meta is None or commit is None or vals is None:
+            raise ErrLightBlockNotFound(f"height {h}")
+        return LightBlock(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validator_set=vals,
+        )
+
+    def report_evidence(self, ev) -> None:
+        self.handle.evidence_pool.add_evidence(ev)
+
+    def consensus_params(self, height: int):
+        params = self.handle.state_store.load_consensus_params(height)
+        if params is None:
+            params = self.handle.cs.state.consensus_params
+        return params
 
 
 def build_node(
@@ -98,6 +151,8 @@ def build_node(
     threaded: bool = True,
     app_factory: Optional[Callable] = None,
     mempool_config: Optional[MempoolConfig] = None,
+    app=None,
+    app_conns=None,
 ) -> NodeHandle:
     """Assemble one validator under ``root/node{index}``.
 
@@ -105,7 +160,10 @@ def build_node(
     the same ``root``) to model a crash-restart from persisted stores.
     ``app_factory`` overrides the default kvstore app — the tx-flood
     scenario wraps it in ``txingest.SigVerifyingApp`` so signed-envelope
-    traffic exercises the batched admission pipeline.
+    traffic exercises the batched admission pipeline.  ``app``/``app_conns``
+    hand in an ALREADY-RUNNING application (the statesync join path: the
+    syncer restored a snapshot into it before the node is assembled, so
+    the handshake must see that instance, not a fresh one).
     """
     config = config or sim_consensus_config()
     home = root / f"node{index}"
@@ -114,17 +172,29 @@ def build_node(
     block_store = BlockStore(db)
     state_store = StateStore(db)
 
-    app = app_factory() if app_factory is not None else KVStoreApplication()
-    conns = AppConns(local_client_creator(app))
-    conns.start()
+    if app is None:
+        app = app_factory() if app_factory is not None else KVStoreApplication()
+    if app_conns is None:
+        conns = AppConns(local_client_creator(app))
+        conns.start()
+    else:
+        conns = app_conns
 
     state = state_store.load()
     if state is None:
         state = state_from_genesis(gdoc)
 
     event_bus = EventBus()
-    handshaker = Handshaker(state_store, block_store, gdoc, event_bus=event_bus)
+    evidence_pool = EvidencePool(db, state_store, block_store)
+    handshaker = Handshaker(
+        state_store,
+        block_store,
+        gdoc,
+        event_bus=event_bus,
+        evidence_pool=evidence_pool,
+    )
     state = handshaker.handshake(state, conns)
+    evidence_pool.state = state
 
     info = conns.query.info()
     mempool = CListMempool(
@@ -140,6 +210,7 @@ def build_node(
         block_store,
         conns.consensus,
         mempool,
+        evidence_pool=evidence_pool,
         event_bus=event_bus,
     )
     key_path = str(home / "pv_key.json")
@@ -160,6 +231,7 @@ def build_node(
         mempool,
         priv_validator=pv,
         wal=wal,
+        evidence_pool=evidence_pool,
         event_bus=event_bus,
         clock=clock,
         ticker_factory=ticker_factory,
@@ -175,4 +247,5 @@ def build_node(
         state_store=state_store,
         event_bus=event_bus,
         priv_val=pv,
+        evidence_pool=evidence_pool,
     )

@@ -61,6 +61,22 @@ class Action:
     fn: Callable[[SimCluster], None]
 
 
+def compose(*generators: Callable[["Scenario"], list[Action]]):
+    """Merge several action generators into one scenario script — the
+    combined-fault composition layer.  Actions keep their scripted times;
+    the virtual clock's (time, schedule-order) ordering resolves ties
+    deterministically, so composing scripts never changes the members'
+    individual timing."""
+
+    def gen(s: "Scenario") -> list[Action]:
+        acts: list[Action] = []
+        for g in generators:
+            acts.extend(g(s))
+        return acts
+
+    return gen
+
+
 @dataclass
 class Scenario:
     name: str
@@ -68,6 +84,9 @@ class Scenario:
     n_vals: int = 4
     target_height: int = 5
     max_time: float = 120.0
+    # standby full nodes beyond the genesis validator set (churn/rotation
+    # scenarios spawn or statesync-join them mid-run)
+    n_spares: int = 0
     link_overrides: dict = field(default_factory=dict)
     actions: Callable[[Scenario], list[Action]] = lambda _s: []
     # setup runs after the cluster is built but before it starts; teardown
@@ -105,6 +124,11 @@ class ScenarioResult:
     # tx-ingestion counters captured at end-of-run (tx-flood): enqueued,
     # shed_to_sync, flushes, batch occupancy, cache hits, rejections…
     ingest: dict = field(default_factory=dict)
+    # evidence-pool counters captured at end-of-run (dup-vote-flood,
+    # light-attack): added/dedup/dropped/rejected/committed…
+    evidence: dict = field(default_factory=dict)
+    # validator-set rotations the invariant checker authenticated
+    rotations: int = 0
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -146,6 +170,10 @@ class ScenarioResult:
                     "recheck_batches",
                 )
             }
+        if self.evidence:
+            row["evidence"] = dict(self.evidence)
+        if self.rotations:
+            row["rotations"] = self.rotations
         return row
 
 
@@ -523,8 +551,12 @@ def _tx_flood(s: Scenario) -> list[Action]:
         secp = Secp256k1PrivKey.from_secret(b"\x41" * 32)
 
         def valid(b: int, i: int) -> bytes:
+            # nonces advance across bursts (b*100+i): a well-behaved sender
+            # never reuses one, so the coalescer's replay LRU only fires on
+            # the scripted replays below
             return ev.sign_tx(
-                privs[i % len(privs)], b"f%d_%d=%d" % (b, i, i), nonce=i
+                privs[i % len(privs)], b"f%d_%d=%d" % (b, i, i),
+                nonce=b * 100 + i,
             )
 
         txs: "list[bytes]" = [valid(burst_no, i) for i in range(36)]
@@ -532,13 +564,15 @@ def _tx_flood(s: Scenario) -> list[Action]:
             ev.sign_tx(secp, b"s%d=%d" % (burst_no, burst_no), nonce=burst_no)
         )
         # forged: structurally valid envelope, signature from a different
-        # preimage (nonce bumped after signing)
+        # preimage (nonce bumped after signing — far past any nonce a later
+        # burst will legitimately use, and never recorded by the replay LRU
+        # because the signature never verifies)
         for i in range(4):
             g = ev.decode(txs[i])
             txs.append(
                 ev.encode(
                     ev.Envelope(
-                        g.key_type, g.pubkey, g.nonce + 100, g.payload,
+                        g.key_type, g.pubkey, g.nonce + 100_000, g.payload,
                         g.signature,
                     )
                 )
@@ -552,12 +586,24 @@ def _tx_flood(s: Scenario) -> list[Action]:
         )
         # in-burst duplicates (same bytes twice before any flush) plus,
         # after burst 0, re-sends of burst 0's first txs — cross-burst
-        # duplicates that are by then cached and possibly committed
+        # duplicates that are by then cached and possibly committed — and
+        # REPLAYS: fresh payloads re-signed under burst 0's nonces, which
+        # must die at ingest with the canonical stale-nonce code instead
+        # of reaching the app (docs/tx-ingest.md replay protection)
         txs += [valid(burst_no, 0), valid(burst_no, 1)]
         if burst_no > 0:
             txs += [valid(0, 0), valid(0, 1)]
+            for i in range(2):
+                txs.append(
+                    ev.sign_tx(
+                        privs[i], b"replay%d_%d=1" % (burst_no, i), nonce=i
+                    )
+                )
         c.rng.shuffle(txs)
 
+        ingestors = getattr(c, "_flood_ingest", None)
+        if ingestors is None:
+            ingestors = c._flood_ingest = {}
         for i, node in enumerate(c.live_nodes()):
             outcomes = {"ok": 0, "rejected": 0, "errors": 0}
 
@@ -567,9 +613,14 @@ def _tx_flood(s: Scenario) -> list[Action]:
                 else:
                     o["errors"] += 1
 
-            ing = IngestCoalescer(
-                node.mempool, start_thread=False, on_result=note
-            )
+            # one coalescer per node for the whole run (like production):
+            # its verified-nonce LRU must span bursts for replay rejection
+            ing = ingestors.get(node.index)
+            if ing is None:
+                ing = ingestors[node.index] = IngestCoalescer(
+                    node.mempool, start_thread=False
+                )
+            ing.on_result = note
             queued = dedup = synced = 0
             for tx in txs:
                 try:
@@ -628,6 +679,347 @@ def _message_storm(s: Scenario) -> list[Action]:
         Action(float(t), "inject txs", inject_txs) for t in (2, 5, 8, 11, 14)
     ]
     return acts
+
+
+# -- fleet-scale churn / rotation scenarios ----------------------------------
+
+
+def _retrying_join(
+    c: SimCluster, idx: int, attempt: int = 0, max_attempts: int = 10
+) -> None:
+    """Statesync-join ``idx``, retrying every 2 virtual seconds while no
+    viable snapshot exists (or another join is mid-flight).  All retries
+    ride the scripted clock, so the whole dance replays from the seed."""
+    if c.nodes[idx] is not None:
+        return
+    if not c.join(idx) and attempt + 1 < max_attempts:
+        c.clock.call_later(
+            2.0,
+            lambda: _retrying_join(c, idx, attempt + 1, max_attempts),
+            label=f"scenario join-retry node{idx}",
+        )
+
+
+def _validator_rotation(s: Scenario) -> list[Action]:
+    """A standby full node comes online at genesis, gets voted in, and a
+    genesis validator is voted out — the minimal end-to-end rotation on
+    the production validate_validator_updates path."""
+    spare = s.n_vals  # first spare index
+
+    return [
+        Action(1.0, f"spawn standby node{spare}",
+               lambda c: c.spawn_spare(spare)),
+        Action(3.0, f"vote node{spare} into the validator set",
+               lambda c: c.add_validator(spare)),
+        Action(7.0, "vote node0 out of the validator set",
+               lambda c: c.remove_validator(0)),
+    ]
+
+
+def _fleet_churn(s: Scenario) -> list[Action]:
+    """The fleet acceptance script: validator rotation + node churn in one
+    run.  A spare is voted in and later joins as a FRESH machine via
+    statesync; the last genesis validator is voted out and gracefully
+    leaves; another validator hard-crashes and restarts from its stores.
+    Scales with n_vals — the nightly lane runs it at 100 validators, the
+    tier-1 lane at a single-digit size."""
+    spare = s.n_vals
+    leaver = s.n_vals - 1
+    crasher = 1
+
+    return [
+        Action(2.0, f"vote spare node{spare} in",
+               lambda c: c.add_validator(spare)),
+        Action(3.0, f"vote node{leaver} out",
+               lambda c: c.remove_validator(leaver)),
+        Action(8.0, f"node{leaver} leaves gracefully",
+               lambda c: c.leave(leaver)),
+        Action(9.0, f"node{spare} joins via statesync",
+               lambda c: _retrying_join(c, spare)),
+        Action(11.0, f"crash node{crasher}", lambda c: c.crash(crasher)),
+        Action(15.0, f"restart node{crasher}", lambda c: c.restart(crasher)),
+    ]
+
+
+def _statesync_storm(s: Scenario) -> list[Action]:
+    """Two joiners statesync through lossy links while a serving peer
+    crashes mid-sync: chunk re-requests must back off exponentially and
+    rotate to surviving peers, and both joins must still complete."""
+    j1, j2 = s.n_vals, s.n_vals + 1
+
+    def degrade(c: SimCluster) -> None:
+        c.net.set_node_links(j1, drop_rate=0.25)
+        c.net.set_node_links(j2, drop_rate=0.25)
+
+    return [
+        Action(0.0, "25% loss on both joiners' links", degrade),
+        Action(9.0, f"node{j1} joins via statesync (lossy)",
+               lambda c: _retrying_join(c, j1)),
+        Action(10.0, "crash node3 (a chunk-serving peer)",
+               lambda c: c.crash(3)),
+        Action(11.0, f"node{j2} joins via statesync (lossy)",
+               lambda c: _retrying_join(c, j2)),
+        Action(15.0, "restart node3", lambda c: c.restart(3)),
+    ]
+
+
+# -- adversarial evidence scenarios ------------------------------------------
+
+
+def _craft_dup_vote(c: SimCluster, signer: int, height: int, round_: int,
+                    tag: bytes, forge: bool = False):
+    """Real (or, with ``forge``, signature-broken) DuplicateVoteEvidence:
+    validator ``signer`` double-signs two synthetic block ids at a
+    committed height, timestamped to that height's block time so the
+    production evidence verification chain accepts it."""
+    import hashlib
+
+    from cometbft_tpu.types.basic import (
+        PRECOMMIT_TYPE,
+        BlockID,
+        PartSetHeader,
+    )
+    from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+    from cometbft_tpu.types.vote import Vote
+
+    node = c.live_nodes()[0]
+    meta = node.block_store.load_block_meta(height)
+    vals = node.state_store.load_validators(height)
+    priv = c.privs[signer]
+    addr = priv.pub_key().address()
+    idx, val = vals.get_by_address(addr)
+
+    def mk(sub: bytes) -> Vote:
+        seed = tag + sub
+        bid = BlockID(
+            hash=hashlib.sha256(seed).digest(),
+            part_set_header=PartSetHeader(
+                total=1, hash=hashlib.sha256(seed + b"p").digest()
+            ),
+        )
+        v = Vote(
+            type_=PRECOMMIT_TYPE,
+            height=height,
+            round_=round_,
+            block_id=bid,
+            timestamp=meta.header.time,
+            validator_address=addr,
+            validator_index=idx,
+        )
+        v.signature = priv.sign(v.sign_bytes(c.gdoc.chain_id))
+        return v
+
+    v1, v2 = mk(b"a"), mk(b"b")
+    if forge:
+        v2.signature = bytes(64)  # structurally plausible, never verifies
+    return DuplicateVoteEvidence.from_votes(
+        v1, v2, meta.header.time, val.voting_power, vals.total_voting_power()
+    )
+
+
+def _craft_light_attack(c: SimCluster, common_height: int,
+                        signers: list[int], forge: bool = False):
+    """Lunatic light-client attack: the header at common_height+1 with a
+    forged app_hash, committed by ``signers`` (a >1/3 subset of the common
+    validator set).  With ``forge`` the signatures are broken, so the
+    evidence must be REJECTED."""
+    import dataclasses
+    import hashlib
+
+    from cometbft_tpu.types.basic import (
+        BLOCK_ID_FLAG_ABSENT,
+        BLOCK_ID_FLAG_COMMIT,
+        PRECOMMIT_TYPE,
+        BlockID,
+        PartSetHeader,
+    )
+    from cometbft_tpu.types.block import Commit
+    from cometbft_tpu.types.evidence import LightClientAttackEvidence
+    from cometbft_tpu.types.light import LightBlock, SignedHeader
+    from cometbft_tpu.types.vote import CommitSig, Vote
+
+    node = c.live_nodes()[0]
+    h = common_height + 1
+    real = node.block_store.load_block_meta(h)
+    common_meta = node.block_store.load_block_meta(common_height)
+    vals_h = node.state_store.load_validators(h)
+    common_vals = node.state_store.load_validators(common_height)
+
+    forged_header = dataclasses.replace(
+        real.header, app_hash=hashlib.sha256(b"lunatic-app-state").digest()
+    )
+    bid = BlockID(
+        hash=forged_header.hash(),
+        part_set_header=PartSetHeader(
+            total=1, hash=hashlib.sha256(b"lunatic-parts").digest()
+        ),
+    )
+    signer_addrs = {c.privs[i].pub_key().address() for i in signers}
+    sigs = []
+    for idx, val in enumerate(vals_h.validators):
+        if val.address not in signer_addrs:
+            sigs.append(
+                CommitSig(
+                    block_id_flag=BLOCK_ID_FLAG_ABSENT,
+                    validator_address=b"",
+                    timestamp=forged_header.time,
+                    signature=b"",
+                )
+            )
+            continue
+        priv = next(
+            c.privs[i]
+            for i in signers
+            if c.privs[i].pub_key().address() == val.address
+        )
+        v = Vote(
+            type_=PRECOMMIT_TYPE,
+            height=h,
+            round_=0,
+            block_id=bid,
+            timestamp=forged_header.time,
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        sig = priv.sign(v.sign_bytes(c.gdoc.chain_id))
+        sigs.append(
+            CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address,
+                timestamp=forged_header.time,
+                signature=bytes(64) if forge else sig,
+            )
+        )
+    commit = Commit(height=h, round_=0, block_id=bid, signatures=sigs)
+    byzantine = [
+        common_vals.get_by_address(a)[1]
+        for a in sorted(signer_addrs)
+        if common_vals.get_by_address(a) is not None
+    ]
+    return LightClientAttackEvidence(
+        conflicting_block=LightBlock(
+            signed_header=SignedHeader(header=forged_header, commit=commit),
+            validator_set=vals_h,
+        ),
+        common_height=common_height,
+        byzantine_validators=byzantine,
+        total_voting_power=common_vals.total_voting_power(),
+        timestamp=common_meta.header.time,
+    )
+
+
+def _flood_pools(c: SimCluster, pieces: list, label: str) -> None:
+    """Offer every crafted piece to every live node's evidence pool (the
+    sim analog of evidence gossip), counting outcomes per node into the
+    byte-compared trace."""
+    from cometbft_tpu.types.evidence import EvidenceError
+
+    for node in c.live_nodes():
+        before = node.evidence_pool.occupancy()
+        rejected = 0
+        for ev in pieces:
+            try:
+                node.evidence_pool.add_evidence(ev)
+            except EvidenceError:
+                rejected += 1
+        depth, size = node.evidence_pool.occupancy()
+        c._log(
+            "scenario: %s node%d: offered=%d rejected=%d pool=%d->%d (%dB)"
+            % (label, node.index, len(pieces), rejected, before[0], depth, size)
+        )
+
+
+def _dup_vote_flood(s: Scenario) -> list[Action]:
+    """Duplicate-vote flood into the evidence pool: each wave mixes fresh
+    real equivocations (distinct rounds), byte-identical duplicates of the
+    first wave, and signature-forged pieces.  Dedup must catch repeats
+    before any signature work, the scenario-shrunk pool bound must degrade
+    overflow to counted drops, forgeries must be rejected — and verified
+    evidence must still reach blocks through proposals while consensus
+    stays unshed."""
+
+    def flood(c: SimCluster, wave: int) -> None:
+        height = 2  # committed well before the first wave fires
+        pieces = []
+        for j in range(12):
+            pieces.append(
+                _craft_dup_vote(
+                    c, signer=1, height=height, round_=wave * 32 + j,
+                    tag=b"flood-%d-%d" % (wave, j),
+                )
+            )
+        # duplicates of wave 0 (identical bytes -> pool dedup, no sig work)
+        for j in range(12):
+            pieces.append(
+                _craft_dup_vote(
+                    c, signer=1, height=height, round_=j,
+                    tag=b"flood-0-%d" % j,
+                )
+            )
+        # forged: must be rejected by verification, never pooled
+        for j in range(4):
+            pieces.append(
+                _craft_dup_vote(
+                    c, signer=2, height=height, round_=wave * 32 + j,
+                    tag=b"forged-%d-%d" % (wave, j), forge=True,
+                )
+            )
+        _flood_pools(c, pieces, "dup-vote flood wave %d" % wave)
+
+    return [
+        Action(float(t), "duplicate-vote flood wave %d" % w,
+               lambda c, w=w: flood(c, w))
+        for w, t in enumerate((4, 6, 8))
+    ]
+
+
+def _light_attack(s: Scenario) -> list[Action]:
+    """Light-client-attack evidence: a real lunatic forgery (>1/3 of the
+    common set double-signing a conflicting header) must verify on the
+    evidence seam and reach a block; a signature-broken variant must be
+    rejected.  Both ride the verify scheduler's evidence class without
+    ever blocking consensus submissions."""
+
+    def attack(c: SimCluster) -> None:
+        real = _craft_light_attack(c, common_height=2, signers=[0, 1])
+        broken = _craft_light_attack(
+            c, common_height=3, signers=[0, 1], forge=True
+        )
+        _flood_pools(c, [real, broken], "light attack")
+
+    return [Action(6.0, "light-client attack evidence", attack)]
+
+
+def _evidence_setup(extra_env: Optional[dict] = None, pool_max: int = 16):
+    """Backend setup (host-oracle seam, scheduler ON so evidence checks
+    ride the evidence class) plus a scenario-shrunk evidence pool bound and
+    clean evidence counters."""
+    base = _backend_faults_setup(
+        dict(
+            {
+                "COMETBFT_TPU_VERIFY_SCHED": "1",
+                "COMETBFT_TPU_SCHED_FLUSH_US": "500",
+            },
+            **(extra_env or {}),
+        )
+    )
+
+    def setup(cluster: SimCluster) -> None:
+        from cometbft_tpu.evidence import stats as evstats
+
+        base(cluster)
+        evstats.reset()
+        for node in cluster.live_nodes():
+            node.evidence_pool.max_pending = pool_max
+
+    return setup
+
+
+def _evidence_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu.evidence import stats as evstats
+
+    _backend_faults_teardown(cluster)
+    evstats.reset()
 
 
 SCENARIOS: dict[str, Scenario] = {
@@ -742,6 +1134,97 @@ SCENARIOS: dict[str, Scenario] = {
             teardown=_backend_faults_teardown,
         ),
         Scenario(
+            "validator-rotation",
+            "a standby full node spawns at genesis, is voted into the "
+            "validator set via a val: tx (validate_validator_updates "
+            "path), then a genesis validator is voted out; the invariant "
+            "checker authenticates every header's validator hashes "
+            "against its own replay of the rotation and verifies commits "
+            "against the height-correct set",
+            n_spares=1,
+            target_height=12,
+            max_time=180.0,
+            actions=_validator_rotation,
+        ),
+        Scenario(
+            "fleet-churn",
+            "the fleet acceptance script: rotation + churn in one run — a "
+            "spare is voted in and statesync-joins as a fresh machine "
+            "(snapshot offer -> chunk fetch over the faulty fabric -> "
+            "catchup tail), the last genesis validator is voted out and "
+            "leaves gracefully, another validator crash-restarts from its "
+            "stores.  Scales with --validators: the nightly soak runs it "
+            "at 100 validators, tier-1 at 8",
+            n_spares=1,
+            target_height=14,
+            max_time=300.0,
+            actions=_fleet_churn,
+        ),
+        Scenario(
+            "statesync-storm",
+            "two fresh nodes statesync-join through 25%-lossy links while "
+            "a chunk-serving peer crashes mid-sync: chunk re-requests must "
+            "back off exponentially (statesync/syncer.py retry seam), "
+            "rotate to surviving peers, and both joins must complete with "
+            "invariants green",
+            n_spares=2,
+            target_height=16,
+            max_time=300.0,
+            actions=_statesync_storm,
+        ),
+        Scenario(
+            "dup-vote-flood",
+            "waves of duplicate-vote evidence (fresh equivocations + "
+            "byte-identical repeats + signature forgeries) flood every "
+            "node's evidence pool against a scenario-shrunk 8-entry "
+            "bound: dedup before signature work, verified overflow "
+            "degrades to counted drops (never memory), forgeries are "
+            "rejected, and real evidence still reaches committed blocks "
+            "through the verifysched evidence class with consensus shed "
+            "0.  Runs on the host-oracle device-runner seam",
+            target_height=12,
+            max_time=240.0,
+            actions=_dup_vote_flood,
+            setup=_evidence_setup(pool_max=8),
+            teardown=_evidence_teardown,
+        ),
+        Scenario(
+            "light-attack",
+            "a real lunatic light-client attack (2 of 4 validators "
+            "double-sign a conflicting app_hash at a committed height) "
+            "must verify through the evidence seam and land in a block; a "
+            "signature-broken variant must be rejected — both on the "
+            "verifysched evidence class, consensus never shed.  Runs on "
+            "the host-oracle device-runner seam",
+            target_height=12,
+            max_time=240.0,
+            actions=_light_attack,
+            setup=_evidence_setup(),
+            teardown=_evidence_teardown,
+        ),
+        Scenario(
+            "combined-storm",
+            "the composition layer's proof: minority partition + device "
+            "backend brownout on f+1 nodes + scripted bulk verify bursts "
+            "run in ONE script (compose()).  Agreement must hold, only "
+            "bulk-class verify work may shed, and the supervisor must "
+            "degrade and re-promote exactly as in the single-fault runs",
+            target_height=14,
+            max_time=300.0,
+            actions=compose(
+                _partition_minority, _backend_brownout, _gossip_burst
+            ),
+            setup=_backend_faults_setup(
+                {
+                    "COMETBFT_TPU_VERIFY_SCHED": "1",
+                    "COMETBFT_TPU_SCHED_QUEUE": "48",
+                    "COMETBFT_TPU_SCHED_FLUSH_US": "500",
+                    "COMETBFT_TPU_BREAKER_THRESHOLD": "1",
+                }
+            ),
+            teardown=_backend_faults_teardown,
+        ),
+        Scenario(
             "backend-flap",
             "device backend fails in bursts of 4 with 2 clean dispatches "
             "between (t=3..14): breaker cycles open/half-open/closed on "
@@ -792,6 +1275,7 @@ def run_scenario(
         raise_on_violation=raise_on_violation,
         app_factory=scenario.app_factory,
         mempool_config=scenario.mempool_config,
+        n_spares=scenario.n_spares,
     )
     for src_dst, overrides in scenario.link_overrides.items():
         cluster.net.set_link(*src_dst, **overrides)
@@ -804,6 +1288,12 @@ def run_scenario(
     backend_stats: dict = {}
     sched_stats: dict = {}
     ingest_counters: dict = {}
+    evidence_counters: dict = {}
+    # per-run evidence counters: the process-wide stats must not bleed one
+    # run's flood into the next run's ScenarioResult
+    from cometbft_tpu.evidence import stats as _evstats
+
+    _evstats.reset()
     try:
         if scenario.setup is not None:
             scenario.setup(cluster)
@@ -842,6 +1332,13 @@ def run_scenario(
             isnap = istats.snapshot()
             if isnap["enqueued"] or isnap["shed_to_sync"] or isnap["flushes"]:
                 ingest_counters = isnap
+        # evidence-pool counters (dup-vote-flood / light-attack): only
+        # when the pool actually saw traffic this run
+        from cometbft_tpu.evidence import stats as evstats
+
+        esnap = evstats.snapshot()
+        if esnap["added"] or esnap["dedup"] or esnap["rejected"]:
+            evidence_counters = esnap
     finally:
         if scenario.teardown is not None:
             scenario.teardown(cluster)
@@ -864,4 +1361,6 @@ def run_scenario(
         backend=backend_stats,
         sched=sched_stats,
         ingest=ingest_counters,
+        evidence=evidence_counters,
+        rotations=cluster.checker.rotations_seen,
     )

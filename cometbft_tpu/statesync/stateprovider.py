@@ -26,9 +26,16 @@ class LightClientStateProvider:
         trust_options: TrustOptions,
         genesis_doc=None,
         logger=None,
+        now_fn=None,
     ):
         self.chain_id = chain_id
         self.genesis_doc = genesis_doc
+        kwargs = {}
+        if now_fn is not None:
+            # determinism seam: the simulator verifies headers whose times
+            # come from its virtual clock, so expiry/drift checks must read
+            # the same clock (production keeps the wall-clock default)
+            kwargs["now_fn"] = now_fn
         self.client = LightClient(
             chain_id,
             trust_options,
@@ -36,6 +43,7 @@ class LightClientStateProvider:
             providers[1:],
             LightStore(MemKV()),
             logger=logger,
+            **kwargs,
         )
 
     def app_hash(self, height: int) -> bytes:

@@ -50,7 +50,23 @@ class _SnapshotInfo:
 
 
 class Syncer:
-    """Reference: statesync/syncer.go syncer."""
+    """Reference: statesync/syncer.go syncer.
+
+    ``clock``/``sleeper`` form the determinism seam (same pattern as the
+    sim ticker): production leaves them unset and gets wall-clock
+    ``time.monotonic`` plus a real ``Event.wait``; the deterministic
+    simulator injects a virtual clock and a sleeper that advances it and
+    delivers scheduled chunk responses, so churn-under-statesync
+    scenarios replay byte-identically from their seed.
+    """
+
+    # chunk re-request backoff: first retry after RETRY_BASE_S, doubling
+    # to RETRY_MAX_S while no new chunk arrives (a burst of losses must
+    # not hammer peers with a flat-rate re-request storm)
+    RETRY_BASE_S = 0.5
+    RETRY_MAX_S = 8.0
+    WAIT_BASE_S = 0.1
+    WAIT_MAX_S = 1.0
 
     def __init__(
         self,
@@ -59,17 +75,36 @@ class Syncer:
         request_chunk: Callable[[str, int, int, int], bool],  # peer,h,fmt,idx
         chunk_timeout: float = 10.0,
         logger=None,
+        clock: Optional[Callable[[], float]] = None,
+        sleeper: Optional[Callable[[float], None]] = None,
     ):
         self.state_provider = state_provider
         self.proxy_app = proxy_app
         self.request_chunk = request_chunk
         self.chunk_timeout = chunk_timeout
         self.logger = logger or liblog.nop_logger()
+        self._clock = clock or time.monotonic
+        self._sleeper = sleeper
         self._lock = threading.Lock()
         self.snapshots: dict[SnapshotKey, _SnapshotInfo] = {}
         self._chunks: dict[int, bytes] = {}
         self._chunk_event = threading.Event()
         self._active: Optional[SnapshotKey] = None
+
+    def _wait(self, timeout: float) -> None:
+        """Block up to ``timeout`` (or until a chunk arrives) on the real
+        clock, or hand control to the injected sleeper on the virtual one."""
+        if self._sleeper is not None:
+            self._sleeper(timeout)
+        else:
+            self._chunk_event.wait(timeout)
+
+    def _sleep(self, duration: float) -> None:
+        """Plain sleep (no chunk wakeup) on whichever clock is injected."""
+        if self._sleeper is not None:
+            self._sleeper(duration)
+        else:
+            time.sleep(duration)
 
     # -- snapshot discovery (reactor feeds these) --------------------------
 
@@ -118,13 +153,13 @@ class Syncer:
         # wait out the FULL discovery window so the best snapshot wins, not
         # merely the first to arrive (reference: SyncAny discoveryTime) —
         # re-polling peers as we wait so fresh snapshots keep arriving
-        deadline = time.monotonic() + discovery_time
-        last_poll = 0.0
-        while time.monotonic() < deadline and is_running():
-            if rediscover is not None and time.monotonic() - last_poll > 3.0:
-                last_poll = time.monotonic()
+        deadline = self._clock() + discovery_time
+        last_poll = -3.0
+        while self._clock() < deadline and is_running():
+            if rediscover is not None and self._clock() - last_poll > 3.0:
+                last_poll = self._clock()
                 rediscover()
-            time.sleep(0.2)
+            self._sleep(0.2)
 
         while is_running():
             best = self._best_snapshot()
@@ -221,31 +256,49 @@ class Syncer:
         return state, commit
 
     def _fetch_chunks(self, snapshot: SnapshotKey) -> None:
-        """Request all chunks from the snapshot's peers, retrying missing
-        ones until the timeout (reference: fetchChunks, concurrent via the
-        reactor's async responses)."""
+        """Request all chunks from the snapshot's peers, re-requesting
+        missing ones on a bounded exponential backoff until the timeout
+        (reference: fetchChunks, concurrent via the reactor's async
+        responses).  Both the re-request interval and the poll wait grow
+        while no new chunk lands and reset to base on progress, so a burst
+        of losses degrades to patient retries instead of a flat-rate
+        re-request storm."""
         if snapshot.chunks == 0:
             return  # a complete zero-chunk snapshot needs no fetching
         with self._lock:
-            peers = list(self.snapshots[snapshot].peers)
+            # sorted: peer rotation must not depend on set iteration order
+            # (the sim's byte-identical replay would otherwise vary with
+            # PYTHONHASHSEED)
+            peers = sorted(self.snapshots[snapshot].peers)
         if not peers:
             raise StatesyncError("no peers for snapshot")
-        deadline = time.monotonic() + self.chunk_timeout * max(snapshot.chunks, 1)
-        next_req = 0.0
-        while time.monotonic() < deadline:
+        deadline = self._clock() + self.chunk_timeout * max(snapshot.chunks, 1)
+        next_req = self._clock()  # first round of requests fires immediately
+        retry_s = self.RETRY_BASE_S
+        wait_s = self.WAIT_BASE_S
+        while self._clock() < deadline:
             with self._lock:
                 missing = [
                     i for i in range(snapshot.chunks) if i not in self._chunks
                 ]
+                have = snapshot.chunks - len(missing)
             if not missing:
                 return
-            if time.monotonic() >= next_req:
+            if self._clock() >= next_req:
                 for n, idx in enumerate(missing):
                     peer = peers[(n + len(missing)) % len(peers)]
                     self.request_chunk(
                         peer, snapshot.height, snapshot.format, idx
                     )
-                next_req = time.monotonic() + 2.0
-            self._chunk_event.wait(timeout=0.1)
+                next_req = self._clock() + retry_s
+                retry_s = min(retry_s * 2.0, self.RETRY_MAX_S)
+            self._wait(wait_s)
             self._chunk_event.clear()
+            with self._lock:
+                progressed = len(self._chunks) > have
+            if progressed:
+                retry_s = self.RETRY_BASE_S
+                wait_s = self.WAIT_BASE_S
+            else:
+                wait_s = min(wait_s * 2.0, self.WAIT_MAX_S)
         raise StatesyncError("timed out fetching chunks")

@@ -21,6 +21,7 @@ from cometbft_tpu.txingest.coalescer import (
 from cometbft_tpu.txingest.envelope import (
     CODE_BAD_ENVELOPE,
     CODE_BAD_SIGNATURE,
+    CODE_STALE_NONCE,
     CODESPACE,
     Envelope,
     EnvelopeError,
@@ -34,6 +35,7 @@ from cometbft_tpu.txingest.middleware import SigVerifyingApp
 __all__ = [
     "CODE_BAD_ENVELOPE",
     "CODE_BAD_SIGNATURE",
+    "CODE_STALE_NONCE",
     "CODESPACE",
     "Envelope",
     "EnvelopeError",

@@ -30,7 +30,7 @@ import logging
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Callable, Optional
 
 from cometbft_tpu.txingest import stats
@@ -40,6 +40,42 @@ logger = logging.getLogger("cometbft_tpu.txingest")
 DEFAULT_BATCH = 256
 DEFAULT_FLUSH_US = 5000.0
 DEFAULT_QUEUE_CAP = 4096
+DEFAULT_NONCE_LRU = 4096
+
+
+class _NonceLRU:
+    """Last-seen *verified* envelope nonce per sender pubkey, LRU-bounded.
+
+    Only nonces whose signatures actually verified are recorded — a forged
+    envelope carrying a huge nonce must not be able to poison a sender's
+    record and censor their future traffic.  A replayed or re-signed
+    envelope at or below the recorded nonce dies at ingest with the
+    canonical ``CODE_STALE_NONCE`` before costing a queue slot, a
+    signature check, or an app round trip.
+
+    Locked: reactor threads consult it at submit while the ingest thread
+    records at flush, and OrderedDict relinking is not thread-safe."""
+
+    def __init__(self, cap: int):
+        self.cap = max(1, cap)
+        self._d: "OrderedDict[bytes, int]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def last(self, pub: bytes) -> Optional[int]:
+        with self._lock:
+            v = self._d.get(pub)
+            if v is not None:
+                self._d.move_to_end(pub)
+            return v
+
+    def note(self, pub: bytes, nonce: int) -> None:
+        with self._lock:
+            cur = self._d.get(pub)
+            if cur is None or nonce > cur:
+                self._d[pub] = nonce
+            self._d.move_to_end(pub)
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
 
 
 def ingest_enabled() -> bool:
@@ -106,6 +142,9 @@ class IngestCoalescer:
         # flush-time outcome callback: (sender, CheckTxResponse-or-
         # MempoolError) — the reactor uses it for per-peer accounting
         self.on_result = on_result
+        self._nonces = _NonceLRU(
+            _env_int("COMETBFT_TPU_TXINGEST_NONCES", DEFAULT_NONCE_LRU)
+        )
         self._cond = threading.Condition()
         self._q: "deque[tuple[bytes, str, float]]" = deque()
         self._thread: Optional[threading.Thread] = None
@@ -143,11 +182,15 @@ class IngestCoalescer:
             stats.record_cache(True)
             stats.record_error("duplicate")
             raise TxInCacheError()
+        stale, pn = self._check_nonce(tx)
+        if stale is not None:
+            return stale
         with self._cond:
             if not self._stopped and len(self._q) < self.queue_cap:
-                # the key rides along so flush-time admission doesn't
-                # hash the tx a second time
-                self._q.append((tx, sender, key, time.perf_counter()))
+                # the key and decoded (pubkey, nonce) ride along so
+                # flush-time admission neither hashes nor decodes the tx
+                # a second time
+                self._q.append((tx, sender, key, time.perf_counter(), pn))
                 stats.record_enqueue()
                 if self._start_thread and (
                     self._thread is None or not self._thread.is_alive()
@@ -161,7 +204,43 @@ class IngestCoalescer:
         # queue full (or closing): shed to the per-tx synchronous path —
         # shedding costs the batching win, never a tx verdict
         stats.record_shed_sync()
-        return self.mempool.check_tx(tx, sender=sender)
+        res = self.mempool.check_tx(tx, sender=sender)
+        self._note_verified_nonce(pn, res)
+        return res
+
+    # -- per-sender nonce replay protection ---------------------------------
+
+    def _check_nonce(self, tx: bytes):
+        """Canonical ``CODE_STALE_NONCE`` rejection for a replayed or
+        re-signed envelope at/below the sender's last verified nonce, as
+        ``(rejection-or-None, (pubkey, nonce)-or-None)`` — the decoded pair
+        rides the queue so flush never re-decodes.  Only meaningful behind
+        an envelope-aware app (same gate as the batched sig precheck)."""
+        if not getattr(self.mempool, "envelope_aware", False):
+            return None, None
+        from cometbft_tpu.txingest import envelope as ev
+
+        if not ev.is_envelope(tx):
+            return None, None
+        try:
+            env = ev.decode(tx)
+        except ev.EnvelopeError:
+            return None, None  # malformed: the canonical 101 path downstream
+        last = self._nonces.last(env.pubkey)
+        if last is not None and env.nonce <= last:
+            stats.record_reject(ev.CODE_STALE_NONCE)
+            stats.record_error("stale_nonce")
+            return ev.reject_stale_nonce(env.nonce, last), None
+        return None, (env.pubkey, env.nonce)
+
+    def _note_verified_nonce(self, pn, res) -> None:
+        """Record a (pubkey, nonce) pair once its tx VERIFIED (res.ok)."""
+        if pn is None:
+            return
+        from cometbft_tpu.abci import types as at
+
+        if isinstance(res, at.CheckTxResponse) and res.ok:
+            self._nonces.note(*pn)
 
     # -- flushing -----------------------------------------------------------
 
@@ -182,9 +261,9 @@ class IngestCoalescer:
             total += len(items)
 
     def _flush_chunk(self, items) -> None:
-        txs = [tx for tx, _, _, _ in items]
-        senders = [sender for _, sender, _, _ in items]
-        keys = [key for _, _, key, _ in items]
+        txs = [it[0] for it in items]
+        senders = [it[1] for it in items]
+        keys = [it[2] for it in items]
         stats.record_flush(len(items), self.batch_max)
         try:
             results = self.mempool.check_tx_batch(txs, senders, keys=keys)
@@ -199,6 +278,8 @@ class IngestCoalescer:
                     results.append(self.mempool.check_tx(tx, sender=sender))
                 except Exception as e:  # noqa: BLE001 — MempoolError family
                     results.append(e)
+        for it, res in zip(items, results):
+            self._note_verified_nonce(it[4], res)
         if self.on_result is not None:
             for sender, res in zip(senders, results):
                 try:

@@ -44,6 +44,7 @@ _DOMAIN = b"cometbft-tpu/tx/v1"
 CODESPACE = "txingest"
 CODE_BAD_ENVELOPE = 101
 CODE_BAD_SIGNATURE = 102
+CODE_STALE_NONCE = 103
 
 
 class EnvelopeError(Exception):
@@ -175,6 +176,14 @@ def reject_bad_signature() -> at.CheckTxResponse:
     return at.CheckTxResponse(
         code=CODE_BAD_SIGNATURE,
         log="invalid tx envelope signature",
+        codespace=CODESPACE,
+    )
+
+
+def reject_stale_nonce(nonce: int, last_seen: int) -> at.CheckTxResponse:
+    return at.CheckTxResponse(
+        code=CODE_STALE_NONCE,
+        log=f"stale envelope nonce {nonce} (last seen {last_seen})",
         codespace=CODESPACE,
     )
 

@@ -29,6 +29,12 @@ DEFAULT_LOG = "/tmp/_t1.log"
 # "in 682.33s", "in 682.33s (0:11:22)"
 _SUMMARY_RE = re.compile(r"\bin\s+([0-9]+(?:\.[0-9]+)?)s(?:\s+\([0-9:]+\))?\s*=*\s*$")
 
+# "12.34s call     tests/test_sim.py::TestScenarios::test_x" — emitted when
+# the suite runs with --durations=N (scripts/gate.sh does)
+_DURATION_RE = re.compile(
+    r"^\s*([0-9]+(?:\.[0-9]+)?)s\s+(call|setup|teardown)\s+(\S+)"
+)
+
 
 def parse_wall_seconds(text: str) -> float | None:
     """Wall seconds from the last pytest summary line, or None."""
@@ -38,6 +44,33 @@ def parse_wall_seconds(text: str) -> float | None:
         if m:
             last = float(m.group(1))
     return last
+
+
+def parse_durations(text: str) -> list[tuple[float, str]]:
+    """(seconds, test id) pairs from a --durations=N section, [] if the
+    log has none."""
+    out = []
+    for line in text.splitlines():
+        m = _DURATION_RE.match(line)
+        if m:
+            out.append((float(m.group(1)), m.group(3)))
+    return out
+
+
+def sim_share(text: str, wall: float) -> str | None:
+    """One-line report of the sim-scenario share of tier-1 wall time, or
+    None when the log carries no --durations section.  A lower bound: the
+    durations table only lists the slowest N items."""
+    durations = parse_durations(text)
+    if not durations or wall <= 0:
+        return None
+    sim_s = sum(s for s, tid in durations if "test_sim" in tid)
+    listed_s = sum(s for s, _ in durations)
+    return (
+        f"tier1-budget: sim scenarios >= {sim_s:.1f}s of {wall:.1f}s wall "
+        f"({100.0 * sim_s / wall:.1f}%; durations table covers "
+        f"{listed_s:.1f}s)"
+    )
 
 
 def main() -> int:
@@ -56,6 +89,7 @@ def main() -> int:
     )
     args = ap.parse_args()
 
+    text = ""
     if args.seconds is not None:
         wall = args.seconds
     else:
@@ -73,6 +107,9 @@ def main() -> int:
                 file=sys.stderr,
             )
             return 1
+    share = sim_share(text, wall) if text else None
+    if share:
+        print(share)
 
     margin = args.budget - wall
     if wall > args.budget:

@@ -7,6 +7,11 @@
 #   1. full pytest suite (CPU, virtual 8-device mesh via tests/conftest.py)
 #   2. bench.py exits 0 and prints a JSON line (any JAX platform)
 #   3. dryrun_multichip(8) on a forced 8-device CPU mesh
+#
+# NIGHTLY=1 additionally runs the slow lane: the -m slow pytest marks
+# (real-kernel scenarios, determinism double-runs, 100-validator fleets)
+# and the sim soak matrix (scenario x seed x scale with per-cell same-seed
+# double runs — invariant violations OR trace divergence fail the gate).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,7 +19,9 @@ echo "== gate 1/5: verify call-site lint =="
 python scripts/check_verify_callsites.py
 
 echo "== gate 2/5: pytest =="
-python -m pytest tests/ -x -q
+rm -f /tmp/_gate_t1.log
+python -m pytest tests/ -x -q --durations=40 2>&1 | tee /tmp/_gate_t1.log
+python scripts/check_tier1_budget.py /tmp/_gate_t1.log
 
 echo "== gate 3/5: bench.py =="
 python bench.py
@@ -25,5 +32,14 @@ XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 
 echo "== gate 5/5: native sanitizers (TSAN+ASAN) =="
 bash scripts/sanitize_native.sh
+
+if [ "${NIGHTLY:-0}" = "1" ]; then
+    echo "== nightly 1/2: slow-lane pytest =="
+    python -m pytest tests/ -x -q -m slow
+
+    echo "== nightly 2/2: sim soak matrix =="
+    python scripts/sim_soak.py --matrix --seeds 2 --scales 8,25 \
+        --out sim_soak_matrix.json
+fi
 
 echo "gate: ALL GREEN"

@@ -1,18 +1,28 @@
 """Seed-sweep soak for the deterministic simulator (cometbft_tpu/sim/).
 
-Runs every scenario (or a named subset) across K seeds and writes a JSON
-summary row per (scenario, seed): heights reached, virtual time, event
-count, commits verified, and the invariant verdict.  CI archives the JSON
-so a robustness regression shows up as a diffable artifact — a seed that
-used to reach the target height and now stalls, or an invariant that
-starts failing — instead of an anecdote about a flaky test.
+Two modes:
+
+  * default — run every scenario (or a named subset) across K seeds and
+    write a JSON summary row per (scenario, seed).
+  * ``--matrix`` — the nightly lane: sweep scenario x seed x cluster-scale
+    (scale-capable scenarios also run at each ``--scales`` size) and run
+    every cell TWICE with the same seed, failing the row on any trace
+    divergence — the byte-identical-trace-per-seed invariant, enforced as
+    a gate instead of an anecdote.  Scale sweeps are what items 1-3 on the
+    roadmap regress against: verification behavior only gets interesting
+    at committee sizes in the hundreds (arXiv:2302.00418).
+
+CI archives the JSON so a robustness regression shows up as a diffable
+artifact — a seed that used to reach the target height and now stalls, an
+invariant that starts failing, or a trace that stops replaying.
 
 Usage:
     python scripts/sim_soak.py [--seeds K] [--scenario NAME ...]
                                [--out sim_soak.json] [--fail-fast]
+    python scripts/sim_soak.py --matrix [--scales 8,25] [--seeds 2]
 
 Every row is reproducible: rerun the exact failure with
-    cometbft-tpu sim --seed <seed> --scenario <scenario>
+    cometbft-tpu sim --seed <seed> --scenario <scenario> [--validators N]
 """
 
 import argparse
@@ -26,10 +36,75 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from cometbft_tpu.sim import SCENARIOS, run_scenario
 
+# scenarios whose fault scripts scale with the cluster size (victim picks,
+# rotation targets and churn indices all derive from n_vals)
+SCALABLE = (
+    "baseline",
+    "partition-minority",
+    "crash-restart",
+    "fleet-churn",
+)
+
+
+def _row_extra(row: dict) -> str:
+    extra = ""
+    backend = row.get("backend") or {}
+    if backend:
+        # backend-* scenarios: breaker activity is part of the verdict a
+        # reviewer wants at a glance
+        extra += " demote=%d repromote=%d watchdog=%d opens=%d" % (
+            backend.get("demotions", 0),
+            backend.get("repromotions", 0),
+            backend.get("watchdog_fires", 0),
+            backend.get("breaker_opens", 0),
+        )
+    ingest = row.get("ingest") or {}
+    if ingest:
+        # tx-flood: admission shape is the at-a-glance verdict — batched
+        # occupancy, sync sheds, dedup hits, rejections
+        extra += " adm=%d shed=%d dedup=%d rej=%d occ=%.2f" % (
+            ingest.get("admitted", 0),
+            ingest.get("shed_to_sync", 0),
+            ingest.get("cache_hits", 0),
+            ingest.get("rejected_total", 0),
+            ingest.get("batch_occupancy", 0.0),
+        )
+    evidence = row.get("evidence") or {}
+    if evidence:
+        # evidence scenarios: pool discipline under flood
+        extra += " evadd=%d dedup=%d drop=%d rej=%d commit=%d" % (
+            evidence.get("added", 0),
+            evidence.get("dedup", 0),
+            evidence.get("dropped", 0),
+            evidence.get("rejected", 0),
+            evidence.get("committed", 0),
+        )
+    if row.get("rotations"):
+        extra += " rot=%d" % row["rotations"]
+    return extra
+
+
+def _run_cell(name: str, seed: int, n_vals, divergence_check: bool) -> dict:
+    """One (scenario, seed, scale) cell; with divergence_check the cell
+    runs twice and the traces are byte-compared."""
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix=f"soak-{name}-{seed}-") as root:
+        res = run_scenario(name, seed, root=root, n_vals=n_vals)
+    row = res.summary()
+    row["wall_seconds"] = round(time.monotonic() - t0, 3)
+    if divergence_check:
+        with tempfile.TemporaryDirectory(
+            prefix=f"soak2-{name}-{seed}-"
+        ) as root:
+            res2 = run_scenario(name, seed, root=root, n_vals=n_vals)
+        row["trace_identical"] = res.trace == res2.trace
+    return row
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--seeds", type=int, default=5, help="seeds per scenario")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="seeds per scenario (default: 5, matrix: 2)")
     ap.add_argument("--seed-base", type=int, default=0)
     ap.add_argument(
         "--scenario",
@@ -37,11 +112,22 @@ def main() -> int:
         default=None,
         help="scenario name (repeatable; default: all)",
     )
+    ap.add_argument(
+        "--matrix", action="store_true",
+        help="nightly mode: scenario x seed x scale sweep with per-cell "
+             "same-seed double runs (trace divergence fails the row)",
+    )
+    ap.add_argument(
+        "--scales", default="8,25",
+        help="comma-separated extra cluster sizes for scale-capable "
+             "scenarios in --matrix mode (default 8,25)",
+    )
     ap.add_argument("--out", default="sim_soak.json")
     ap.add_argument(
         "--fail-fast", action="store_true", help="stop at the first bad row"
     )
     args = ap.parse_args()
+    seeds = args.seeds if args.seeds is not None else (2 if args.matrix else 5)
 
     names = args.scenario or list(SCENARIOS)
     unknown = [n for n in names if n not in SCENARIOS]
@@ -50,63 +136,64 @@ def main() -> int:
               file=sys.stderr)
         return 2
 
+    # build the cell list: (scenario, seed, n_vals-override-or-None)
+    cells = []
+    for name in names:
+        scales = [None]
+        if args.matrix and name in SCALABLE:
+            scales += [
+                int(s) for s in args.scales.split(",") if s.strip()
+            ]
+        for n_vals in scales:
+            for seed in range(args.seed_base, args.seed_base + seeds):
+                cells.append((name, seed, n_vals))
+
     rows = []
     failures = 0
     t0 = time.monotonic()
-    for name in names:
-        for seed in range(args.seed_base, args.seed_base + args.seeds):
-            with tempfile.TemporaryDirectory(
-                prefix=f"soak-{name}-{seed}-"
-            ) as root:
-                res = run_scenario(name, seed, root=root)
-            row = res.summary()
-            rows.append(row)
-            ok = row["reached"] and row["invariants_ok"]
-            backend = row.get("backend") or {}
-            extra = ""
-            if backend:
-                # backend-* scenarios: breaker activity is part of the
-                # verdict a reviewer wants at a glance
-                extra = " demote=%d repromote=%d watchdog=%d opens=%d" % (
-                    backend.get("demotions", 0),
-                    backend.get("repromotions", 0),
-                    backend.get("watchdog_fires", 0),
-                    backend.get("breaker_opens", 0),
-                )
-            ingest = row.get("ingest") or {}
-            if ingest:
-                # tx-flood: admission shape is the at-a-glance verdict —
-                # batched occupancy, sync sheds, dedup hits, rejections
-                extra += " adm=%d shed=%d dedup=%d rej=%d occ=%.2f" % (
-                    ingest.get("admitted", 0),
-                    ingest.get("shed_to_sync", 0),
-                    ingest.get("cache_hits", 0),
-                    ingest.get("rejected_total", 0),
-                    ingest.get("batch_occupancy", 0.0),
-                )
-            print(
-                "%-20s seed=%-4d %s heights=%s events=%d%s"
-                % (
-                    name,
-                    seed,
-                    "ok  " if ok else "FAIL",
-                    row["heights"],
-                    row["events"],
-                    extra,
-                )
+    for name, seed, n_vals in cells:
+        row = _run_cell(name, seed, n_vals, divergence_check=args.matrix)
+        rows.append(row)
+        ok = (
+            row["reached"]
+            and row["invariants_ok"]
+            and row.get("trace_identical", True)
+        )
+        tag = "ok  " if ok else "FAIL"
+        if not row.get("trace_identical", True):
+            tag = "DIVG"
+        # -1 slots are departed/never-spawned nodes (fleet-churn's leaver),
+        # not stalled members — keep them out of the min column
+        live_heights = [h for h in row["heights"] if h >= 0] or [-1]
+        print(
+            "%-20s seed=%-4d n=%-3d %s heights[min/max]=%d/%d events=%d "
+            "wall=%.1fs%s"
+            % (
+                name,
+                seed,
+                row["n_vals"],
+                tag,
+                min(live_heights),
+                max(live_heights),
+                row["events"],
+                row["wall_seconds"],
+                _row_extra(row),
             )
-            if not ok:
-                failures += 1
-                for v in row["violations"]:
-                    print(f"  violation: {v}")
-                if args.fail_fast:
-                    break
-        if failures and args.fail_fast:
-            break
+        )
+        if not ok:
+            failures += 1
+            for v in row["violations"]:
+                print(f"  violation: {v}")
+            if not row.get("trace_identical", True):
+                print("  trace diverged between two same-seed runs")
+            if args.fail_fast:
+                break
 
     summary = {
-        "seeds_per_scenario": args.seeds,
+        "mode": "matrix" if args.matrix else "sweep",
+        "seeds_per_scenario": seeds,
         "scenarios": names,
+        "scales": args.scales if args.matrix else None,
         "rows": rows,
         "failures": failures,
         "wall_seconds": round(time.monotonic() - t0, 3),

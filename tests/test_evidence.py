@@ -224,3 +224,110 @@ class TestEvidencePool:
         ev = _dupe_evidence(privs, valset)
         with pytest.raises(EvidenceInvalidError):
             pool.check_evidence(state, [ev, ev])
+
+
+class TestEvidencePoolBounds:
+    """Flood hardening: dedup before signature work, a hard pending-pool
+    size bound that degrades overflow to counted drops, and the
+    ``cometbft_evidence_*`` counters those outcomes feed."""
+
+    def _setup(self, **kw):
+        from cometbft_tpu.evidence import stats as evstats
+
+        evstats.reset()
+        setup = TestEvidencePool._setup(self)
+        privs, state, pool, valset = setup
+        for k, v in kw.items():
+            setattr(pool, k, v)
+        return privs, state, pool, valset
+
+    def _distinct(self, privs, valset, n, height=1):
+        return [
+            _dupe_evidence_round(privs, valset, height=height, round_=r)
+            for r in range(n)
+        ]
+
+    def test_pool_size_bound_degrades_to_drops(self):
+        from cometbft_tpu.evidence import stats as evstats
+
+        privs, state, pool, valset = self._setup(max_pending=2)
+        pieces = self._distinct(privs, valset, 5)
+        for ev in pieces:
+            pool.add_evidence(ev)  # overflow must NOT raise
+        assert len(pool.all_pending()) == 2
+        depth, size = pool.occupancy()
+        assert depth == 2 and size > 0
+        snap = evstats.snapshot()
+        assert snap["added"] == 2
+        assert snap["dropped"] == 3
+        assert snap["pool_depth"] == 2
+        assert snap["pool_bytes"] == size
+
+    def test_byte_bound_also_enforced(self):
+        privs, state, pool, valset = self._setup(max_pending_bytes=1)
+        pool.add_evidence(_dupe_evidence(privs, valset))  # first admitted:
+        # the bound is checked before the write, so one entry always fits
+        pool.add_evidence(self._distinct(privs, valset, 2)[1])
+        assert len(pool.all_pending()) == 1
+
+    def test_dedup_counts_before_signature_work(self):
+        from cometbft_tpu.evidence import stats as evstats
+
+        privs, state, pool, valset = self._setup()
+        ev = _dupe_evidence(privs, valset)
+        pool.add_evidence(ev)
+        pool.add_evidence(ev)
+        pool.add_evidence(ev)
+        snap = evstats.snapshot()
+        assert snap["added"] == 1 and snap["dedup"] == 2
+
+    def test_rejected_and_committed_counters(self):
+        from cometbft_tpu.types.evidence import EvidenceError
+
+        from cometbft_tpu.evidence import stats as evstats
+
+        privs, state, pool, valset = self._setup()
+        good = _dupe_evidence(privs, valset)
+        pool.add_evidence(good)
+        bad = self._distinct(privs, valset, 2)[1]
+        bad.validator_power = 3
+        with pytest.raises(EvidenceError):
+            pool.add_evidence(bad)
+        pool.update(state, [good])
+        snap = evstats.snapshot()
+        assert snap["rejected"] == 1
+        assert snap["committed"] == 1
+        assert snap["pool_depth"] == 0
+
+    def test_occupancy_survives_pool_rebuild(self):
+        """A pool rebuilt over the same db (restart) seeds its occupancy
+        from a scan, so the bound keeps holding."""
+        from cometbft_tpu.evidence.pool import EvidencePool
+
+        privs, state, pool, valset = self._setup()
+        for ev in self._distinct(privs, valset, 3):
+            pool.add_evidence(ev)
+        rebuilt = EvidencePool(
+            pool._db, pool.state_store, pool.block_store, max_pending=3
+        )
+        assert rebuilt.occupancy()[0] == 3
+        rebuilt.add_evidence(self._distinct(privs, valset, 4)[3])
+        assert rebuilt.occupancy()[0] == 3  # dropped: already at the bound
+
+    def test_metrics_exposed(self):
+        from cometbft_tpu.libs.metrics import NodeMetrics
+
+        privs, state, pool, valset = self._setup()
+        pool.add_evidence(_dupe_evidence(privs, valset))
+        body = NodeMetrics().registry.expose()
+        assert "cometbft_evidence_pool_depth 1" in body
+        assert "cometbft_evidence_added 1" in body
+
+
+def _dupe_evidence_round(privs, valset, height=1, round_=0):
+    """Distinct-per-round equivocation (the flood scenarios' shape)."""
+    v1 = _signed_vote(privs[0], valset, height, round_, _block_id(b"a%d" % round_))
+    v2 = _signed_vote(privs[0], valset, height, round_, _block_id(b"b%d" % round_))
+    return DuplicateVoteEvidence.from_votes(
+        v1, v2, Timestamp(100, 0), 10, valset.total_voting_power()
+    )

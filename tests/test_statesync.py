@@ -1,6 +1,10 @@
 """Statesync integration: a fresh node bootstraps from an app snapshot
 discovered over p2p, verified through the light-client state provider
-(reference test model: statesync/syncer_test.go + e2e statesync cases)."""
+(reference test model: statesync/syncer_test.go + e2e statesync cases).
+
+Plus unit coverage for the syncer's clock/sleeper determinism seam and
+the bounded exponential backoff on chunk re-requests — the machinery the
+deterministic simulator's churn-under-statesync scenarios ride."""
 
 import hashlib
 import time
@@ -16,6 +20,133 @@ from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 from tests.test_reactors import _make_node_home, _wait_for
 
 CHAIN_ID = "statesync-test-chain"
+
+
+# ---------------------------------------------------------------------------
+# syncer clock/sleeper seam + chunk-request backoff (unit, virtual time)
+# ---------------------------------------------------------------------------
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestSyncerBackoff:
+    def _syncer(self, clock, on_wait=None, chunk_timeout=10.0, peers=("p1", "p2")):
+        from cometbft_tpu.statesync.syncer import (
+            SnapshotKey,
+            Syncer,
+            _SnapshotInfo,
+        )
+
+        requests = []  # (virtual time, peer, chunk index)
+
+        def request_chunk(peer, height, fmt, idx):
+            requests.append((clock.t, peer, idx))
+            return True
+
+        def sleeper(timeout):
+            # the determinism seam: waiting advances the fake clock and
+            # optionally delivers scripted chunk responses
+            clock.t += timeout
+            if on_wait is not None:
+                on_wait(syncer)
+
+        syncer = Syncer(
+            state_provider=None,
+            proxy_app=None,
+            request_chunk=request_chunk,
+            chunk_timeout=chunk_timeout,
+            clock=clock,
+            sleeper=sleeper,
+        )
+        snap = SnapshotKey(height=10, format=1, hash=b"\x01" * 32, chunks=4)
+        syncer.snapshots[snap] = _SnapshotInfo(snap, peers=set(peers))
+        syncer._active = snap
+        return syncer, snap, requests
+
+    def test_rerequests_back_off_exponentially_then_time_out(self):
+        from cometbft_tpu.statesync.syncer import StatesyncError, Syncer
+
+        clock = _FakeClock()
+        syncer, snap, requests = self._syncer(clock, chunk_timeout=10.0)
+        with pytest.raises(StatesyncError, match="timed out"):
+            syncer._fetch_chunks(snap)
+        # request rounds fire at doubling intervals (0.5 -> 1 -> 2 -> 4 ->
+        # 8, capped) while no chunk lands; the flat-rate 2 s storm and the
+        # flat 0.1 s poll are both gone
+        round_times = sorted({t for t, _, _ in requests})
+        gaps = [
+            round(b - a, 6) for a, b in zip(round_times, round_times[1:])
+        ]
+        assert gaps == sorted(gaps), f"backoff must be non-decreasing: {gaps}"
+        assert gaps[0] >= Syncer.RETRY_BASE_S
+        assert max(gaps) <= Syncer.RETRY_MAX_S + Syncer.WAIT_MAX_S
+        assert any(g >= Syncer.RETRY_MAX_S for g in gaps), gaps
+        # every missing chunk was re-requested each round, rotating peers
+        assert {i for _, _, i in requests} == {0, 1, 2, 3}
+
+    def test_progress_resets_backoff_and_completes(self):
+        clock = _FakeClock()
+        state = {"delivered": 0}
+
+        def on_wait(syncer):
+            # deliver one chunk every virtual second or so
+            want = int(clock.t)
+            while state["delivered"] < min(want, 4):
+                i = state["delivered"]
+                syncer.add_chunk(10, 1, i, b"chunk%d" % i)
+                state["delivered"] += 1
+
+        syncer, snap, requests = self._syncer(clock, on_wait=on_wait)
+        syncer._fetch_chunks(snap)  # returns without raising
+        assert len(syncer._chunks) == 4
+        # completion long before the timeout: backoff reset on progress
+        assert clock.t < 10.0
+
+    def test_peer_rotation_is_hash_order_independent(self):
+        clock = _FakeClock()
+        syncer, snap, requests = self._syncer(
+            clock, chunk_timeout=0.2, peers=("pB", "pA", "pC")
+        )
+        from cometbft_tpu.statesync.syncer import StatesyncError
+
+        with pytest.raises(StatesyncError):
+            syncer._fetch_chunks(snap)
+        first_round = [p for t, p, _ in requests if t == 0.0]
+        # peers assigned from the SORTED list ((n + missing) % len
+        # rotation): deterministic across processes regardless of set
+        # iteration order
+        assert first_round == ["pB", "pC", "pA", "pB"]
+
+    def test_discovery_window_polls_on_injected_clock(self):
+        from cometbft_tpu.statesync.syncer import ErrNoSnapshots, Syncer
+
+        clock = _FakeClock()
+        polls = []
+
+        def sleeper(timeout):
+            clock.t += timeout
+
+        syncer = Syncer(
+            state_provider=None,
+            proxy_app=None,
+            request_chunk=lambda *a: True,
+            clock=clock,
+            sleeper=sleeper,
+        )
+        with pytest.raises(ErrNoSnapshots):
+            syncer.sync_any(
+                6.0,
+                is_running=lambda: True,
+                rediscover=lambda: polls.append(clock.t),
+            )
+        assert clock.t >= 6.0  # the full window elapsed on the fake clock
+        assert len(polls) >= 2  # re-polled every ~3 virtual seconds
 
 
 @pytest.fixture(scope="module")

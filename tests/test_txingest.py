@@ -46,6 +46,7 @@ from cometbft_tpu.proxy.multi_app_conn import AppConns, local_client_creator
 from cometbft_tpu.txingest import (
     CODE_BAD_ENVELOPE,
     CODE_BAD_SIGNATURE,
+    CODE_STALE_NONCE,
     CODESPACE,
     IngestCoalescer,
     SigVerifyingApp,
@@ -1045,3 +1046,100 @@ class TestMetricsExposition:
         assert snap["batch_occupancy"] == 0.75
         istats.reset()
         assert istats.snapshot()["flushes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# per-sender nonce replay protection (coalescer last-verified-nonce LRU)
+# ---------------------------------------------------------------------------
+
+
+class TestNonceReplayProtection:
+    """Replayed / re-signed envelopes at or below a sender's last VERIFIED
+    nonce die at ingest with the canonical ``CODE_STALE_NONCE`` — before a
+    queue slot, a signature check, or an app round trip.  Only verified
+    nonces are recorded, so forged envelopes cannot poison a sender."""
+
+    def _ing(self, **kw):
+        _, mp = _stack(max_tx_bytes=512)
+        ing = IngestCoalescer(mp, start_thread=False, **kw)
+        return mp, ing
+
+    def _admit(self, ing, tx):
+        res = ing.submit(tx, sender="peer")
+        if res is None:
+            ing.flush_now()
+        return res
+
+    def test_replay_below_verified_nonce_rejected(self, ingest_env):
+        mp, ing = self._ing()
+        assert self._admit(ing, sign_tx(ED_PRIVS[0], b"a=1", nonce=5)) is None
+        # fresh payload re-signed under an old nonce: canonical 103
+        res = ing.submit(sign_tx(ED_PRIVS[0], b"a=2", nonce=5), sender="peer")
+        assert res is not None and res.code == CODE_STALE_NONCE
+        assert res.codespace == CODESPACE
+        res = ing.submit(sign_tx(ED_PRIVS[0], b"a=3", nonce=4), sender="peer")
+        assert res.code == CODE_STALE_NONCE
+        # the mempool never saw either replay
+        assert mp.size() == 1
+        snap = istats.snapshot()
+        assert snap["rejected"].get(str(CODE_STALE_NONCE), 0) == 2
+        assert snap["errors"].get("stale_nonce", 0) == 2
+        # a genuinely fresh nonce still admits
+        assert self._admit(ing, sign_tx(ED_PRIVS[0], b"a=4", nonce=6)) is None
+        ing.flush_now()
+        assert mp.size() == 2
+
+    def test_forged_high_nonce_cannot_poison_sender(self, ingest_env):
+        mp, ing = self._ing()
+        good = sign_tx(ED_PRIVS[0], b"k=1", nonce=1)
+        e = ev.decode(good)
+        forged = ev.encode(
+            ev.Envelope(e.key_type, e.pubkey, 10_000, e.payload, e.signature)
+        )
+        assert self._admit(ing, forged) is None  # queued, rejected at flush
+        # the forgery was rejected with 102 and its nonce NOT recorded:
+        assert self._admit(ing, good) is None
+        ing.flush_now()
+        assert mp.size() == 1  # the honest tx made it in
+        snap = istats.snapshot()
+        assert snap["rejected"].get(str(CODE_BAD_SIGNATURE), 0) == 1
+        assert snap["rejected"].get(str(CODE_STALE_NONCE), 0) == 0
+
+    def test_shed_to_sync_path_also_records_nonces(self, ingest_env):
+        mp, ing = self._ing(queue_cap=1)
+        ing.submit(sign_tx(ED_PRIVS[1], b"q=0", nonce=3), sender="p")  # queued
+        # queue full -> synchronous path; its verified nonce must count
+        res = ing.submit(sign_tx(ED_PRIVS[0], b"s=1", nonce=7), sender="p")
+        assert res is not None and res.ok
+        stale = ing.submit(sign_tx(ED_PRIVS[0], b"s=2", nonce=7), sender="p")
+        assert stale.code == CODE_STALE_NONCE
+        ing.flush_now()
+
+    def test_lru_eviction_forgets_oldest_sender(self, monkeypatch, ingest_env):
+        monkeypatch.setenv("COMETBFT_TPU_TXINGEST_NONCES", "1")
+        mp, ing = self._ing()
+        assert self._admit(ing, sign_tx(ED_PRIVS[0], b"x=1", nonce=5)) is None
+        assert self._admit(ing, sign_tx(ED_PRIVS[1], b"y=1", nonce=5)) is None
+        # sender 0 was evicted from the 1-slot LRU: its replay now reaches
+        # the app (bounded memory beats perfect replay recall)
+        res = ing.submit(sign_tx(ED_PRIVS[0], b"x=2", nonce=5), sender="p")
+        assert res is None
+        ing.flush_now()
+
+    def test_plain_and_malformed_txs_bypass_nonce_check(self, ingest_env):
+        mp, ing = self._ing()
+        assert self._admit(ing, b"plain=1") is None
+        bad = ev.MAGIC + b"\x99junk"
+        assert self._admit(ing, bad) is None  # malformed: canonical 101 path
+        snap = istats.snapshot()
+        assert snap["rejected"].get(str(CODE_STALE_NONCE), 0) == 0
+
+    def test_inactive_pipeline_skips_nonce_check(self, monkeypatch, clean_stats):
+        monkeypatch.setenv("COMETBFT_TPU_TXINGEST", "0")
+        mp, ing = self._ing()
+        tx1 = sign_tx(ED_PRIVS[0], b"z=1", nonce=5)
+        tx2 = sign_tx(ED_PRIVS[0], b"z=2", nonce=5)
+        assert ing.submit(tx1, sender="p") is not None  # sync passthrough
+        res = ing.submit(tx2, sender="p")
+        # kill switch restores per-tx behavior bit-for-bit: no 103
+        assert res is None or res.code != CODE_STALE_NONCE
