@@ -415,6 +415,13 @@ class Node(BaseService):
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
+        # warm-boot the verify compile matrix in the background (docs/
+        # warm-boot.md): on the trusted tpu backend the node reaches full
+        # verify throughput without its first commits paying a compile.
+        # jax-free when disabled; failures demote tiers via the breaker.
+        from cometbft_tpu.ops import warmboot
+
+        self._warmboot_thread = warmboot.start()
         if self.indexer_service is not None:
             self.indexer_service.start()
         # background pruner (reference: node/node.go createPruner; the

@@ -52,22 +52,39 @@ def msm(points: Sequence[Optional[tuple]], scalars: Sequence[int],
     """Host API: Σ scalarᵢ·pointᵢ on the device; returns affine (x, y) or
     None for infinity.  ``nbits`` bounds every scalar (128 suffices for
     random-linear-combination batch verification)."""
+    from cometbft_tpu.ops import aot_cache
+
     assert len(points) == len(scalars)
     if not points:
         return None
     p = pack_points(points)
     b = p.x.v.shape[1]
     bits = jnp.asarray(pack_scalar_bits(scalars, nbits, b))
-    out = _msm_kernel(p.x, p.y, p.z, bits)
+    out = aot_cache.cached_call(
+        _msm_kernel, (p.x, p.y, p.z, bits), f"bls-msm-{b}x{nbits}"
+    )
     return unpack_points(out)[0]
+
+
+def _sum_core(px, py, pz):
+    return lane_sum(G1(px, py, pz))
+
+
+# module-level jit: the previous per-call ``jax.jit(lambda ...)`` built a
+# fresh wrapper every call, retracing+recompiling the same shape each time
+_sum_kernel = jax.jit(_sum_core)
 
 
 def sum_points(points: Sequence[Optional[tuple]]) -> Optional[tuple]:
     """Host API: Σ pointᵢ (no scalars — e.g. aggregate-pubkey sums)."""
+    from cometbft_tpu.ops import aot_cache
+
     if not points:
         return None
     p = pack_points(points)
-    out = jax.jit(lambda x, y, z: lane_sum(G1(x, y, z)))(p.x, p.y, p.z)
+    out = aot_cache.cached_call(
+        _sum_kernel, (p.x, p.y, p.z), f"bls-sum-{p.x.v.shape[1]}"
+    )
     return unpack_points(out)[0]
 
 
@@ -80,11 +97,15 @@ def batch_scalar_mul(points: Sequence[Optional[tuple]],
                      scalars: Sequence[int], nbits: int = 128) -> list:
     """Host API: per-lane [scalarᵢ·pointᵢ] (no lane sum) — the shape the
     RLC pairing product needs (each rᵢ·pkᵢ pairs with its own H(mᵢ))."""
+    from cometbft_tpu.ops import aot_cache
+
     assert len(points) == len(scalars)
     if not points:
         return []
     p = pack_points(points)
     b = p.x.v.shape[1]
     bits = jnp.asarray(pack_scalar_bits(scalars, nbits, b))
-    out = _batch_mul_kernel(p.x, p.y, p.z, bits)
+    out = aot_cache.cached_call(
+        _batch_mul_kernel, (p.x, p.y, p.z, bits), f"bls-mul-{b}x{nbits}"
+    )
     return unpack_points(out)[: len(points)]

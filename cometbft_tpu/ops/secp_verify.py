@@ -139,8 +139,14 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
     q = CURVE.pack_points(points)
     u1_bits = jnp.asarray(pack_scalar_bits(u1s, NBITS, b))
     u2_bits = jnp.asarray(pack_scalar_bits(u2s, NBITS, b))
-    xs, ys, zs = _ladder_kernel(
-        g.x, g.y, g.z, q.x, q.y, q.z, u1_bits, u2_bits
+    # exec-cache seam (docs/warm-boot.md): the ~25s XLA ladder compile is
+    # persisted per batch shape, so a fresh process deserializes it
+    from cometbft_tpu.ops import aot_cache
+
+    xs, ys, zs = aot_cache.cached_call(
+        _ladder_kernel,
+        (g.x, g.y, g.z, q.x, q.y, q.z, u1_bits, u2_bits),
+        f"secp-ladder-{b}x{NBITS}",
     )
     # host post: affine x, compare mod n (bigints; only the raw limbs
     # matter to fpgen.unpack — the bounds on the template are unused)

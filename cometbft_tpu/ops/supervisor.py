@@ -332,9 +332,6 @@ def _attempt(backend: str, pubs, msgs, sigs) -> np.ndarray:
 
     min_b = ov._PALLAS_MIN_BUCKET if backend == "pallas" else ov._BUCKETS[0]
     arrays, n, structural = ov.prepare_batch(pubs, msgs, sigs, min_b)
-    kernel = (
-        ov._verify_kernel_pallas if backend == "pallas" else ov._verify_kernel
-    )
     lanes = arrays["s_ok"].shape[0]
     inj = _FAULT_INJECTOR
     runner = _DEVICE_RUNNER
@@ -345,8 +342,13 @@ def _attempt(backend: str, pubs, msgs, sigs) -> np.ndarray:
         if runner is not None:
             out = np.asarray(runner(backend, pubs, msgs, sigs, lanes))
         else:
+            # executable resolution (exec-cache load or AOT compile) runs
+            # INSIDE the watchdog worker: a wedged compile is abandoned
+            # like a wedged dispatch, and the device-runner seam above
+            # never pays a compile at all
+            call, _ = ov.bucket_executable(backend, lanes)
             out = np.asarray(
-                kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
+                call(**{k: jnp.asarray(v) for k, v in arrays.items()})
             )
         if transform is not None:
             out = transform(out)
@@ -505,9 +507,6 @@ def verify_batches_overlapped_supervised(work) -> list:
         return [host_verify(*w) for w in work]
     br = reg.breaker(backend)
     min_b = ov._PALLAS_MIN_BUCKET if backend == "pallas" else ov._BUCKETS[0]
-    kernel = (
-        ov._verify_kernel_pallas if backend == "pallas" else ov._verify_kernel
-    )
 
     inflight: list = []  # (dev_or_None, transform, n, structural, lanes, w)
     dead = False
@@ -529,8 +528,9 @@ def verify_batches_overlapped_supervised(work) -> list:
                 # device-runner seam (sim/tests): synchronous stand-in —
                 # np.asarray at fetch time is then a no-op
                 return np.asarray(runner(backend, *w, lanes)), transform
+            call, _ = ov.bucket_executable(backend, lanes)
             return (
-                kernel(**{k: jnp.asarray(v) for k, v in arrays.items()}),
+                call(**{k: jnp.asarray(v) for k, v in arrays.items()}),
                 transform,
             )
 

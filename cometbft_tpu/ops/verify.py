@@ -22,11 +22,21 @@ from __future__ import annotations
 
 import contextlib
 import hashlib
-from typing import Sequence
+import os
+import threading
+import warnings
+from typing import Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+# Donated input buffers that XLA cannot alias to the (much smaller) accept
+# bitmap produce a cosmetic compile-time warning; donation still lets the
+# compiler reuse them as scratch.  Message-scoped so real warnings survive.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 from cometbft_tpu.ops import dispatch_stats
 from cometbft_tpu.ops import fe25519 as fe
@@ -40,7 +50,17 @@ L_INT = 2**252 + 27742317777372353535851937790883648493
 # lanes ~linearly, so small-bucket dispatches are ~4-5x faster, which is
 # what keeps the CPU test suite inside its budget).  Pallas keeps a
 # 128-lane floor: the Mosaic lowering tiles on the 8x128 lane grid.
-_BUCKETS = [32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 10240, 16384, 32768]
+#
+# The ladder is deliberately sparse above 1024 (2048 and 16384 were
+# pruned): per-bucket dispatch histograms (dispatch_stats.snapshot()
+# ["buckets"]) across tier-1, the sim scenarios and bench show nothing
+# lands between the blocksync-window shapes (<=1024: votes, evidence
+# pairs, <=100-validator commits, 8-commit prefetch windows) and the
+# commit/bench shapes (>=4096: 10k-validator commits, bench sweeps).
+# Every pruned shape is a compile the warm-boot matrix no longer pays per
+# backend tier.
+_BUCKETS = [32, 64, 128, 256, 512, 1024, 4096, 8192, 10240, 32768]
+_PRUNED_BUCKETS = (2048, 16384)
 _PALLAS_MIN_BUCKET = 128
 
 
@@ -111,7 +131,17 @@ def _decompress_pair(ya, sa, yr, sr):
     return ok_all[:t], a, ok_all[t:], r
 
 
+_DONATE_ARGS = ("a_bytes", "r_bytes", "s_bytes", "m_bytes", "s_ok")
+
 _verify_kernel = jax.jit(verify_core)
+# Donated variant for the steady-state hot loop: the padded input buffers
+# are freshly packed per dispatch (prepare_batch -> jnp.asarray) and never
+# reused by the caller, so XLA may alias them for its outputs/scratch
+# instead of allocating — steady-state verify stops paying alloc+copy per
+# dispatch.  Callers that DO reuse device-resident inputs across calls
+# (bench.py's timed reps, chip_validate's vector suite) use the
+# non-donated executables.
+_verify_kernel_donated = jax.jit(verify_core, donate_argnames=_DONATE_ARGS)
 
 
 def select_impl(devices=None) -> str:
@@ -138,13 +168,147 @@ def _use_pallas() -> bool:
     return select_impl() == "pallas"
 
 
-@jax.jit
-def _verify_kernel_pallas(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
+def _pallas_core(a_bytes, r_bytes, s_bytes, m_bytes, s_ok):
     from cometbft_tpu.ops import pallas_verify
 
     return pallas_verify.verify_core_pallas(
         a_bytes, r_bytes, s_bytes, m_bytes, s_ok
     )
+
+
+_verify_kernel_pallas = jax.jit(_pallas_core)
+_verify_kernel_pallas_donated = jax.jit(
+    _pallas_core, donate_argnames=_DONATE_ARGS
+)
+
+
+# -- AOT executable cache seam ----------------------------------------------
+#
+# Every bucketed verify dispatch obtains its executable here instead of
+# calling the jitted kernels directly: on first use of a (impl, lanes,
+# donated) shape the executable is AOT-compiled (or deserialized from the
+# on-disk cache, skipping tracing AND compilation) and memoized for the
+# process.  The memo plays the role jit's internal cache played — including
+# its documented limitation that trace-time env vars
+# (COMETBFT_TPU_MERGED_DECOMPRESS) only take effect before a shape's first
+# use; aot_cache keys the DISK entries on them.
+
+_EXEC_LOCK = threading.Lock()
+_EXEC_CACHE: dict = {}  # (impl, lanes, donated) -> callable
+# impls whose AOT lowering/serialization failed: per-impl, not global, so
+# a pallas lowering failure cannot cost the healthy xla fallback tier its
+# disk-cache loads.  A latched impl still verifies — through plain jit,
+# which retries compilation lazily — it only loses the AOT layer.
+_AOT_BROKEN: set = set()
+
+
+def aot_enabled() -> bool:
+    """COMETBFT_TPU_AOT=0 bypasses the executable cache entirely and
+    restores the plain jit dispatch path (bisection escape hatch)."""
+    return os.environ.get("COMETBFT_TPU_AOT", "1") != "0"
+
+
+def donation_enabled() -> bool:
+    """Whether the hot loop uses input-donating executables by default.
+
+    ``COMETBFT_TPU_DONATE=1/0`` overrides; the default is ON exactly for
+    the Pallas/TPU production path.  The XLA-CPU CI path defaults OFF on
+    purpose: donation changes the compiled artifact, so defaulting it on
+    would force a fresh ~100s compile of every bucket shape the first time
+    a host runs this code (measured on the CI host) for an aliasing win
+    that only matters at device-HBM bandwidth.  Callers that reuse
+    device-resident inputs across calls (bench timed reps, chip_validate)
+    always pass ``donated=False`` explicitly."""
+    env = os.environ.get("COMETBFT_TPU_DONATE")
+    if env is not None:
+        return env != "0"
+    return _use_pallas()
+
+
+def bucket_tag(impl: str, lanes: int, donated: bool = False) -> str:
+    """On-disk cache tag for one bucket executable.  The non-donated form
+    is shared with bench.py/chip_validate's direct load_or_compile use;
+    donation changes the compiled artifact (input aliasing), so donated
+    executables get their own entry."""
+    base = f"verify-{impl}-{lanes}"
+    return base + "-donated" if donated else base
+
+
+def _bucket_jitted(impl: str, donated: bool):
+    if impl == "pallas":
+        return (
+            _verify_kernel_pallas_donated if donated else _verify_kernel_pallas
+        )
+    return _verify_kernel_donated if donated else _verify_kernel
+
+
+def _bucket_shapes(lanes: int) -> dict:
+    byte = jax.ShapeDtypeStruct((lanes, 32), jnp.uint8)
+    return dict(
+        a_bytes=byte,
+        r_bytes=byte,
+        s_bytes=byte,
+        m_bytes=byte,
+        s_ok=jax.ShapeDtypeStruct((lanes,), jnp.bool_),
+    )
+
+
+def bucket_executable(
+    impl: str, lanes: int, donated: "Optional[bool]" = None
+):
+    """The executable for one padded bucket shape: (call, info).
+
+    ``call(**arrays)`` runs it (async dispatch, same calling convention as
+    the jitted kernels).  info["exec_cache"] records where it came from:
+    ``memo`` (process cache), ``hit`` (deserialized from disk — no tracing,
+    no compilation), ``miss``/``stale`` + ``compile_s`` (freshly built and
+    persisted), ``disabled``/``broken`` (plain jit fallback)."""
+    if donated is None:
+        donated = donation_enabled()
+    jitted = _bucket_jitted(impl, donated)
+    if not aot_enabled():
+        return jitted, {"exec_cache": "disabled"}
+    if impl in _AOT_BROKEN:
+        return jitted, {"exec_cache": "broken-impl"}
+    key = (impl, lanes, bool(donated))
+    with _EXEC_LOCK:
+        memo = _EXEC_CACHE.get(key)
+    if memo is not None:
+        return memo, {"exec_cache": "memo"}
+    from cometbft_tpu.ops import aot_cache
+
+    try:
+        call, info = aot_cache.load_or_compile(
+            jitted, _bucket_shapes(lanes), bucket_tag(impl, lanes, donated)
+        )
+    except Exception as e:  # noqa: BLE001 — AOT lowering/compile failed:
+        # degrade THIS impl to plain jit for the rest of the process,
+        # never fail a verify dispatch over cache plumbing (warmboot.run
+        # reads the "broken:" status and demotes the tier via its breaker)
+        _AOT_BROKEN.add(impl)
+        return jitted, {"exec_cache": f"broken:{type(e).__name__}"}
+    with _EXEC_LOCK:
+        # two racing compilers: first writer wins, both results correct
+        call = _EXEC_CACHE.setdefault(key, call)
+    return call, info
+
+
+def reset_executable_memo() -> None:
+    """Drop the in-process executable memos — both this layer's and
+    aot_cache's probe/memo/latch state (tests: force disk loads)."""
+    with _EXEC_LOCK:
+        _EXEC_CACHE.clear()
+    _AOT_BROKEN.clear()
+    from cometbft_tpu.ops import aot_cache
+
+    aot_cache.reset_memo()
+
+
+def _dispatch_bucket(arrays: dict, impl: str):
+    """Ship one packed bucket to the device; returns the UNFETCHED device
+    array so overlapped callers keep their async-dispatch pipelining."""
+    call, _ = bucket_executable(impl, arrays["s_ok"].shape[0])
+    return call(**{k: jnp.asarray(v) for k, v in arrays.items()})
 
 
 def prepare_batch(
@@ -255,11 +419,8 @@ def verify_batch(
     if supervisor.enabled():
         return supervisor.verify_supervised(pubs, msgs, sigs)
     arrays, n, structural = prepare_batch(pubs, msgs, sigs, _min_bucket())
-    kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
     dispatch_stats.record_dispatch(arrays["s_ok"].shape[0], n)
-    accept = np.asarray(
-        kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
-    )
+    accept = np.asarray(_dispatch_bucket(arrays, select_impl()))
     return (accept & structural)[:n]
 
 
@@ -286,13 +447,13 @@ def verify_batches_overlapped(
 
     if supervisor.enabled():
         return supervisor.verify_batches_overlapped_supervised(work)
-    kernel = _verify_kernel_pallas if _use_pallas() else _verify_kernel
+    impl = select_impl()
     min_b = _min_bucket()
     inflight = []  # (device result, n, structural)
     for pubs, msgs, sigs in work:
         arrays, n, structural = prepare_batch(pubs, msgs, sigs, min_b)
         dispatch_stats.record_dispatch(arrays["s_ok"].shape[0], n)
-        dev = kernel(**{k: jnp.asarray(v) for k, v in arrays.items()})
+        dev = _dispatch_bucket(arrays, impl)
         inflight.append((dev, n, structural))  # no block: async dispatch
     return [
         (np.asarray(dev) & structural)[:n] for dev, n, structural in inflight

@@ -1,0 +1,220 @@
+"""Warm-boot pass: precompile the padding-bucket × backend verify matrix.
+
+A node that spends its first minute compiling is a node that misses rounds
+(ISSUE 8; the committee-consensus measurements in PAPERS.md show commit-path
+verification LATENCY decides consensus performance).  This module walks the
+collapsed compile matrix — every padding bucket in ``ops.verify._BUCKETS``
+for every tier of the supervisor degradation chain — through
+``ops.verify.bucket_executable`` at node boot, in a background thread, so
+the first real commit meets a resident executable instead of a tracer.
+With the on-disk exec cache (``ops/aot_cache.py``) warm from a previous
+boot, the whole pass is deserialization: zero tracing, zero compilation.
+
+Supervisor-aware by design:
+
+* each degradation tier is warmed independently (a demoted node re-promotes
+  into warm executables, not into a compile);
+* a tier whose breaker is OPEN is skipped (warming a dead device is probe
+  traffic the breaker exists to prevent);
+* a COMPILE failure records a breaker failure for that tier and moves on —
+  boot is never wedged, and the failure surfaces through the exact same
+  demotion machinery a dispatch failure would use.
+
+Enablement: ``COMETBFT_TPU_WARMBOOT=1/0`` overrides; the default is ON
+exactly when the trusted ``tpu`` batch backend is active (the gate the
+fused stream / scheduler / tx-ingest share) — CPU-backend nodes and test
+processes never burn minutes compiling shapes they dispatch in
+milliseconds.  ``COMETBFT_TPU_WARMBOOT_BUCKETS`` (comma-separated) bounds
+the matrix (bench and tests use it).
+
+Counters land in ``ops/warm_stats`` (warm_runs / warm_seconds /
+shapes_warmed / shapes_pruned / warm_failures) and surface as
+``cometbft_crypto_warmboot_*`` metrics.  docs/warm-boot.md is the design
+note.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger("cometbft_tpu.crypto")
+
+_LOCK = threading.Lock()
+_THREAD: "list[Optional[threading.Thread]]" = [None]
+_DONE = threading.Event()  # a pass COMPLETED in this process
+
+
+def enabled() -> bool:
+    """Explicit ``COMETBFT_TPU_WARMBOOT`` wins; otherwise default on for
+    the trusted tpu batch backend only.  jax-free (the whole point is
+    deciding whether to pay device-backend init)."""
+    env = os.environ.get("COMETBFT_TPU_WARMBOOT")
+    if env is not None:
+        return env != "0"
+    from cometbft_tpu.verifysched import service
+
+    return service.backend_trusted()
+
+
+def _env_buckets() -> "Optional[list[int]]":
+    raw = os.environ.get("COMETBFT_TPU_WARMBOOT_BUCKETS")
+    if not raw:
+        return None
+    try:
+        return sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return None
+
+
+def warm_matrix() -> "list[tuple[str, int]]":
+    """(backend, bucket) shapes to warm, smallest buckets first so the
+    commit-sized shapes (votes, small validator sets) come online before
+    the 32k bench sweeps.  Honors each tier's padding floor (Pallas never
+    dispatches sub-128 buckets) and the env bucket bound."""
+    from cometbft_tpu.ops import supervisor
+    from cometbft_tpu.ops import verify as ov
+
+    buckets = _env_buckets() or list(ov._BUCKETS)
+    shapes = []
+    for b in sorted(buckets):
+        for backend in supervisor.device_chain():
+            floor = (
+                ov._PALLAS_MIN_BUCKET
+                if backend == "pallas"
+                else ov._BUCKETS[0]
+            )
+            if b >= floor and b in ov._BUCKETS:
+                shapes.append((backend, b))
+    return shapes
+
+
+def run() -> dict:
+    """Synchronously warm the matrix; returns a report dict.
+
+    ``statuses`` maps ``"backend-bucket"`` to the exec_cache outcome
+    (``hit`` / ``miss``+compiled / ``memo`` / ``error:*`` / ``skipped:
+    breaker-open``).  Never raises."""
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.ops import verify as ov
+    from cometbft_tpu.ops import warm_stats
+
+    t0 = time.perf_counter()
+    reg = backend_health.registry()
+    statuses: dict = {}
+    warmed = failures = 0
+    dead: set = set()
+    for backend, bucket in warm_matrix():
+        key = f"{backend}-{bucket}"
+        if backend in dead:
+            statuses[key] = "skipped:tier-demoted"
+            continue
+        if reg.breaker(backend).state == backend_health.OPEN:
+            statuses[key] = "skipped:breaker-open"
+            continue
+        try:
+            _, info = ov.bucket_executable(backend, bucket)
+            # a miss/stale probe that then compiled reports "compiled" —
+            # the per-shape statuses are what bench --warmboot asserts on
+            status = (
+                "compiled"
+                if "compile_s" in info
+                else str(info.get("exec_cache", "?"))
+            )
+            statuses[key] = status
+            if status.startswith("broken:"):
+                # bucket_executable swallows compile/lowering failures
+                # into a fresh "broken:*" status (a dispatch must never
+                # die on cache plumbing) — the warm pass is where they
+                # become breaker failures, so the tier demotes through
+                # the same machinery a dispatch failure would use.  The
+                # breaker self-heals: if the tier's plain-jit dispatch is
+                # actually healthy (only the AOT layer failed), the next
+                # HALF_OPEN probe re-promotes it.
+                raise RuntimeError(f"warm compile failed: {status}")
+            if status in ("disabled", "broken-impl"):
+                # nothing was actually precompiled: AOT off, or the impl
+                # latched broken by an EARLIER pass/dispatch — the breaker
+                # failure was recorded then; re-recording one per pass
+                # would walk a healthy-dispatch tier's breaker open
+                continue
+            warmed += 1
+        except Exception as e:  # noqa: BLE001 — a compile failure demotes
+            # the tier via the breaker; boot itself never wedges
+            failures += 1
+            dead.add(backend)
+            statuses.setdefault(key, f"error:{type(e).__name__}")
+            reg.breaker(backend).record_failure(e)
+            reg.record_demotion(backend)
+            logger.warning(
+                "warm-boot: compiling %s failed (%r); tier demoted via "
+                "breaker, continuing with the next tier",
+                key,
+                e,
+            )
+    # shapes the collapsed matrix no longer pays, per warmed tier
+    tiers = {b for b, _ in warm_matrix()} or {"xla"}
+    pruned = len(ov._PRUNED_BUCKETS) * len(tiers)
+    seconds = time.perf_counter() - t0
+    warm_stats.record_warm_run(seconds, warmed, pruned, failures)
+    report = {
+        "statuses": statuses,
+        "warmed": warmed,
+        "failures": failures,
+        "pruned": pruned,
+        "seconds": round(seconds, 3),
+    }
+    logger.info(
+        "warm-boot: %d shapes warm in %.1fs (%d failures, %d pruned)",
+        warmed,
+        seconds,
+        failures,
+        pruned,
+    )
+    return report
+
+
+def start() -> "Optional[threading.Thread]":
+    """Kick the warm-boot pass on a background daemon thread (node boot
+    path).  No-op when disabled, already running, or already COMPLETED in
+    this process — the matrix only needs warming once, and re-running it
+    would double-count warm_runs/shapes metrics on every late
+    ``ensure_started`` call site (the verifysched dispatcher).  Returns
+    the thread (the finished one after completion)."""
+    if not enabled():
+        return None
+    with _LOCK:
+        t = _THREAD[0]
+        if t is not None and (t.is_alive() or _DONE.is_set()):
+            return t
+        t = threading.Thread(target=_run_once, name="crypto-warmboot",
+                             daemon=True)
+        _THREAD[0] = t
+        t.start()
+        return t
+
+
+def _run_once() -> None:
+    try:
+        run()
+    finally:
+        _DONE.set()
+
+
+def ensure_started() -> None:
+    """Idempotent ``start`` for lazy call sites (the verifysched
+    dispatcher kicks it when the scheduler first activates)."""
+    try:
+        start()
+    except Exception:  # noqa: BLE001 — warm-boot is never load-bearing
+        pass
+
+
+def reset() -> None:
+    """Forget the started thread and the completion latch (tests)."""
+    with _LOCK:
+        _THREAD[0] = None
+        _DONE.clear()

@@ -352,6 +352,13 @@ class VerifyScheduler:
 
     def _run(self) -> None:
         full = self._bucket_target()  # jax import happens here, unlocked
+        # the dispatcher only exists when the trusted backend is active —
+        # the exact population warm-boot serves: precompile the bucket x
+        # tier matrix in the background so the first flush (and the first
+        # post-demotion flush) meets a resident executable
+        from cometbft_tpu.ops import warmboot
+
+        warmboot.ensure_started()
         while True:
             with self._cond:
                 while not self._stopped and (

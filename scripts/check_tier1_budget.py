@@ -35,6 +35,25 @@ _DURATION_RE = re.compile(
     r"^\s*([0-9]+(?:\.[0-9]+)?)s\s+(call|setup|teardown)\s+(\S+)"
 )
 
+# "tier1-exec-cache: compiles=3 compile_s=61.2 hits=9 load_s=14.1 ..." —
+# printed by tests/conftest.py's terminal summary (ops/warm_stats)
+_EXEC_RE = re.compile(
+    r"tier1-exec-cache:\s+compiles=(\d+)\s+compile_s=([0-9.]+)\s+"
+    r"hits=(\d+)\s+load_s=([0-9.]+)"
+)
+
+# tests whose dominant cost is a device-kernel compile (the population the
+# warm-boot PR targets); used for the durations-table compile share
+_COMPILE_HEAVY = (
+    "test_bls_g1",
+    "test_secp_batch",
+    "test_pallas",
+    "test_ed25519_jax",
+    "test_ops",
+    "test_mesh",
+    "test_verify_stream",
+)
+
 
 def parse_wall_seconds(text: str) -> float | None:
     """Wall seconds from the last pytest summary line, or None."""
@@ -71,6 +90,42 @@ def sim_share(text: str, wall: float) -> str | None:
         f"({100.0 * sim_s / wall:.1f}%; durations table covers "
         f"{listed_s:.1f}s)"
     )
+
+
+def compile_share(text: str, wall: float) -> "list[str]":
+    """Report lines for the compile-time share of tier-1 wall time.
+
+    Two complementary views (both lower bounds):
+      * the exec-cache summary line (exact in-process trace+compile
+        seconds, but blind to spawned node subprocesses);
+      * the durations table restricted to the compile-heavy kernel test
+        files (captures a test's whole wall time, compile included)."""
+    out = []
+    if wall <= 0:
+        return out
+    m = None
+    for m in _EXEC_RE.finditer(text):
+        pass  # keep the LAST summary line, like the wall-time parse
+    if m is not None:
+        compiles, compile_s = int(m.group(1)), float(m.group(2))
+        hits, load_s = int(m.group(3)), float(m.group(4))
+        out.append(
+            f"tier1-budget: kernel compiles {compiles} "
+            f"({compile_s:.1f}s, {100.0 * compile_s / wall:.1f}% of wall); "
+            f"exec-cache hits {hits} ({load_s:.1f}s loading)"
+        )
+    durations = parse_durations(text)
+    if durations:
+        heavy = sum(
+            s for s, tid in durations
+            if any(name in tid for name in _COMPILE_HEAVY)
+        )
+        out.append(
+            f"tier1-budget: compile-heavy kernel tests >= {heavy:.1f}s of "
+            f"{wall:.1f}s wall ({100.0 * heavy / wall:.1f}%; durations "
+            "table lower bound)"
+        )
+    return out
 
 
 def main() -> int:
@@ -110,6 +165,9 @@ def main() -> int:
     share = sim_share(text, wall) if text else None
     if share:
         print(share)
+    if text:
+        for line in compile_share(text, wall):
+            print(line)
 
     margin = args.budget - wall
     if wall > args.budget:

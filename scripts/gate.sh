@@ -15,6 +15,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# Shared AOT executable cache (docs/warm-boot.md): repo-local so every
+# gate stage — pytest (and the node subprocesses it spawns), bench, the
+# multichip dry-run — loads executables the previous stage or a previous
+# gate run compiled, instead of re-tracing per process.
+export COMETBFT_TPU_EXEC_CACHE="${COMETBFT_TPU_EXEC_CACHE:-$PWD/.exec_cache}"
+
 echo "== gate 1/5: verify call-site lint =="
 python scripts/check_verify_callsites.py
 

@@ -21,6 +21,21 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.cache/jax")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# AOT executable cache (docs/warm-boot.md): REPO-LOCAL, not ~/.cache, so
+# tier-1 test processes (including spawned e2e node subprocesses, which
+# inherit this environ) share warmed executables without leaking state
+# across checkouts.  Entries skip tracing AND compilation on load.
+os.environ.setdefault(
+    "COMETBFT_TPU_EXEC_CACHE", os.path.join(_REPO, ".exec_cache")
+)
+# The background warm-boot pass would compile the whole bucket matrix on
+# this throttled CPU host the moment any test activates the trusted
+# backend — tests warm shapes on demand instead (test_warmboot drives the
+# pass explicitly).
+os.environ.setdefault("COMETBFT_TPU_WARMBOOT", "0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -33,10 +48,53 @@ jax.config.update(
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long soak runs excluded from the tier-1 suite"
     )
+    config.addinivalue_line(
+        "markers",
+        "warmcache(tag, ...): compile-heavy test that runs in tier-1 only "
+        "when every named exec-cache tag is already warm on disk; demoted "
+        "to the slow lane (which warms the cache) otherwise",
+    )
+
+
+def _exec_cache_warm(tags) -> bool:
+    try:
+        from cometbft_tpu.ops import aot_cache
+
+        # loadable, not has: XLA-CPU's thunk runtime serializes entries it
+        # cannot reload cross-process — those must stay in the slow lane
+        return bool(tags) and all(aot_cache.loadable(t) for t in tags)
+    except Exception:  # noqa: BLE001 — a cold probe must never break collection
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    """Compile-heavy tests return to tier-1 when the shared exec cache can
+    serve their executables warm (a previous full-suite/nightly run stored
+    them); cold entries keep them in the slow lane, which pays the compile
+    ONCE and warms the cache for every later tier-1 run."""
+    import pytest
+
+    for item in items:
+        m = item.get_closest_marker("warmcache")
+        if m is not None and not _exec_cache_warm(m.args):
+            item.add_marker(pytest.mark.slow)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """One parseable exec-cache line in the tier-1 log —
+    scripts/check_tier1_budget.py reads the compile-time share from it.
+    Per-process counters: spawned node subprocesses keep their own, so
+    this is a lower bound on suite-wide compile time."""
+    try:
+        from cometbft_tpu.ops import warm_stats
+
+        terminalreporter.write_line(warm_stats.summary_line())
+    except Exception:  # noqa: BLE001
+        pass

@@ -567,12 +567,15 @@ class TestMetricsAndTooling:
 # ----------------------------------------------------------------------
 
 
-@pytest.mark.slow
+@pytest.mark.warmcache("verify-xla-32")
 def test_real_dispatch_smoke(monkeypatch):
     """One real kernel dispatch end-to-end: submit -> flush ->
-    verify_segments -> supervisor -> XLA -> futures (nightly lane: the
-    tier-1 soft budget has no headroom for a possibly-cold kernel compile,
-    and every layer below the oracle seam is already tier-1-covered by
+    verify_segments -> supervisor -> XLA -> futures.  Runs in tier-1 when
+    the shared exec cache can serve the 32-lane bucket executable warm
+    (ops/aot_cache — the load skips tracing AND compilation); rides the
+    slow lane, which pays the compile once and warms the cache, otherwise
+    (the tier-1 soft budget has no headroom for a cold kernel compile, and
+    every layer below the oracle seam is already tier-1-covered by
     test_verify_stream/test_supervisor)."""
     from cometbft_tpu.crypto import backend_health
 

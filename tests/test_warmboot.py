@@ -1,0 +1,204 @@
+"""ops/warmboot: the boot-time precompile pass over the bucket x backend
+matrix (docs/warm-boot.md).
+
+The executable seam (``ops.verify.bucket_executable``) is monkeypatched
+throughout — these tests pin the MATRIX WALK, breaker integration and
+threading, not the compiles themselves (test_aot_cache covers the cache;
+bench.py --warmboot drives the real cold/warm boots)."""
+
+import threading
+
+import pytest
+
+from cometbft_tpu.crypto import backend_health
+from cometbft_tpu.ops import verify as ov
+from cometbft_tpu.ops import warm_stats, warmboot
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    backend_health.reset()
+    warmboot.reset()
+    yield
+    backend_health.reset()
+    warmboot.reset()
+
+
+class TestEnablement:
+    def test_env_override_wins(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT", "0")
+        assert not warmboot.enabled()
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT", "1")
+        assert warmboot.enabled()
+
+    def test_default_follows_trusted_backend(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_WARMBOOT", raising=False)
+        monkeypatch.setenv("COMETBFT_TPU_CRYPTO_BACKEND", "tpu")
+        assert warmboot.enabled()
+        monkeypatch.setenv("COMETBFT_TPU_CRYPTO_BACKEND", "cpu")
+        assert not warmboot.enabled()
+
+
+class TestMatrix:
+    def test_every_bucket_per_tier_with_floors(self, monkeypatch):
+        from cometbft_tpu.ops import supervisor
+
+        monkeypatch.setattr(
+            supervisor, "device_chain", lambda: ("pallas", "xla")
+        )
+        shapes = warmboot.warm_matrix()
+        # xla warms every bucket; pallas only >= its Mosaic tile floor
+        assert [b for t, b in shapes if t == "xla"] == list(ov._BUCKETS)
+        assert [b for t, b in shapes if t == "pallas"] == [
+            b for b in ov._BUCKETS if b >= ov._PALLAS_MIN_BUCKET
+        ]
+        # ascending: small commit shapes come online first
+        xs = [b for _, b in shapes]
+        assert xs == sorted(xs)
+
+    def test_pruned_buckets_not_in_matrix(self):
+        shapes = {b for _, b in warmboot.warm_matrix()}
+        for pruned in ov._PRUNED_BUCKETS:
+            assert pruned not in shapes
+
+    def test_env_bound(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "64,32")
+        assert [b for _, b in warmboot.warm_matrix()] == [32, 64]
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "garbage")
+        assert warmboot.warm_matrix()  # unparsable -> full matrix
+
+
+class TestRun:
+    def test_warms_matrix_and_records(self, monkeypatch):
+        calls = []
+
+        def fake_exec(backend, bucket, donated=None):
+            calls.append((backend, bucket))
+            return (lambda **kw: None), {"exec_cache": "hit"}
+
+        monkeypatch.setattr(ov, "bucket_executable", fake_exec)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32,64")
+        s0 = warm_stats.snapshot()
+        report = warmboot.run()
+        assert calls == [("xla", 32), ("xla", 64)]
+        assert report["warmed"] == 2 and report["failures"] == 0
+        assert set(report["statuses"].values()) == {"hit"}
+        assert report["pruned"] == len(ov._PRUNED_BUCKETS)
+        s1 = warm_stats.snapshot()
+        assert s1["warm_runs"] == s0["warm_runs"] + 1
+        assert s1["shapes_warmed"] == s0["shapes_warmed"] + 2
+        assert s1["shapes_pruned"] > s0["shapes_pruned"]
+
+    def test_compile_failure_demotes_via_breaker(self, monkeypatch):
+        """A compile failure must surface through the EXISTING breaker
+        machinery (demotion counter + recorded failure) and never wedge
+        the pass — remaining shapes of that tier are skipped, the pass
+        returns normally."""
+
+        def fake_exec(backend, bucket, donated=None):
+            raise RuntimeError("compile exploded")
+
+        monkeypatch.setattr(ov, "bucket_executable", fake_exec)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32,64")
+        d0 = backend_health.snapshot()["demotions"]
+        report = warmboot.run()  # must not raise
+        assert report["failures"] == 1
+        assert report["statuses"]["xla-32"] == "error:RuntimeError"
+        assert report["statuses"]["xla-64"] == "skipped:tier-demoted"
+        assert backend_health.snapshot()["demotions"] == d0 + 1
+        br = backend_health.registry().breaker("xla")
+        assert br.stats()["consecutive_failures"] >= 1
+
+    def test_broken_status_demotes_via_breaker(self, monkeypatch):
+        """bucket_executable swallows compile failures into a "broken:*"
+        status (a dispatch must never die on cache plumbing) — the warm
+        pass must read that status as a COMPILE FAILURE: breaker failure +
+        demotion + remaining tier shapes skipped, not warmed += 1."""
+
+        def fake_exec(backend, bucket, donated=None):
+            return (lambda **kw: None), {"exec_cache": "broken:RuntimeError"}
+
+        monkeypatch.setattr(ov, "bucket_executable", fake_exec)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32,64")
+        d0 = backend_health.snapshot()["demotions"]
+        report = warmboot.run()
+        assert report["failures"] == 1 and report["warmed"] == 0
+        assert report["statuses"]["xla-32"] == "broken:RuntimeError"
+        assert report["statuses"]["xla-64"] == "skipped:tier-demoted"
+        assert backend_health.snapshot()["demotions"] == d0 + 1
+        br = backend_health.registry().breaker("xla")
+        assert br.stats()["consecutive_failures"] >= 1
+
+    def test_disabled_status_not_counted_warm(self, monkeypatch):
+        """COMETBFT_TPU_AOT=0 returns plain jit: nothing was precompiled,
+        so the pass must not report those shapes as warmed (and must not
+        demote anything either)."""
+
+        def fake_exec(backend, bucket, donated=None):
+            return (lambda **kw: None), {"exec_cache": "disabled"}
+
+        monkeypatch.setattr(ov, "bucket_executable", fake_exec)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32,64")
+        report = warmboot.run()
+        assert report["warmed"] == 0 and report["failures"] == 0
+        assert set(report["statuses"].values()) == {"disabled"}
+
+    def test_open_breaker_skipped(self, monkeypatch):
+        """Warming a dead device is probe traffic the breaker exists to
+        prevent: an OPEN tier is skipped wholesale."""
+        called = []
+        monkeypatch.setattr(
+            ov,
+            "bucket_executable",
+            lambda *a, **k: called.append(a)
+            or ((lambda **kw: None), {"exec_cache": "hit"}),
+        )
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
+        br = backend_health.registry().breaker("xla")
+        for _ in range(br.threshold):
+            br.record_failure(RuntimeError("dead"))
+        assert br.state == backend_health.OPEN
+        report = warmboot.run()
+        assert not called
+        assert report["statuses"]["xla-32"] == "skipped:breaker-open"
+
+
+class TestStart:
+    def test_start_disabled_is_noop(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT", "0")
+        assert warmboot.start() is None
+
+    def test_start_background_and_idempotent(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT", "1")
+        started = threading.Event()
+        release = threading.Event()
+        runs = []
+
+        def fake_run():
+            runs.append(1)
+            started.set()
+            release.wait(5)
+            return {}
+
+        monkeypatch.setattr(warmboot, "run", fake_run)
+        t1 = warmboot.start()
+        assert t1 is not None and started.wait(5)
+        # second start while running: same thread, no second pass
+        assert warmboot.start() is t1
+        warmboot.ensure_started()  # never raises, never double-starts
+        release.set()
+        t1.join(5)
+        assert not t1.is_alive()
+        # a COMPLETED pass is never re-run: a late ensure_started (the
+        # verifysched dispatcher, minutes after boot) must not re-walk
+        # the matrix and double-count the warmboot metrics
+        assert warmboot.start() is t1
+        warmboot.ensure_started()
+        assert len(runs) == 1
+        # explicit reset (tests/new-process semantics) re-arms it
+        warmboot.reset()
+        release.set()
+        t2 = warmboot.start()
+        assert t2 is not None and t2 is not t1
+        t2.join(5)
+        assert len(runs) == 2
