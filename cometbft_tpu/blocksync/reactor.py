@@ -315,19 +315,26 @@ class BlocksyncReactor(Reactor):
             to_fuse.append((fp, h, prepared, bits, miss))
         if not to_fuse:
             return
+        from cometbft_tpu.libs import tracing
         from cometbft_tpu.ops import verify as ov
 
         try:
-            results = ov.verify_segments(
-                [
-                    (
-                        [p.pubs[j] for j in miss],
-                        [p.msgs[j] for j in miss],
-                        [p.sigs[j] for j in miss],
-                    )
-                    for _, _, p, _, miss in to_fuse
-                ]
-            )
+            with tracing.span(
+                "blocksync.prefetch",
+                commits=len(to_fuse),
+                h0=to_fuse[0][1],
+                sigs=sum(len(miss) for *_, miss in to_fuse),
+            ):
+                results = ov.verify_segments(
+                    [
+                        (
+                            [p.pubs[j] for j in miss],
+                            [p.msgs[j] for j in miss],
+                            [p.sigs[j] for j in miss],
+                        )
+                        for _, _, p, _, miss in to_fuse
+                    ]
+                )
         except Exception as e:  # noqa: BLE001 — prefetch must never stall sync
             self.logger.error("fused verify prefetch failed", err=repr(e))
             return

@@ -295,6 +295,104 @@ def cmd_sim(args) -> int:
 
 
 
+def cmd_trace(args) -> int:
+    """Snapshot the verify-pipeline flight recorder + pipeline health as
+    one JSON document (docs/observability.md).  ``--rpc`` queries a
+    running node's ``/debug/verify_trace`` endpoint; ``--local`` renders
+    this process's own recorder (mostly useful under test harnesses that
+    import the node in-process)."""
+    if args.local:
+        from cometbft_tpu.libs import tracing
+
+        doc = tracing.trace_document(max_spans=args.spans)
+    else:
+        import urllib.request
+
+        addr = args.rpc
+        if addr.startswith("tcp://"):
+            addr = "http://" + addr[len("tcp://"):]
+        if not addr.startswith(("http://", "https://")):
+            addr = "http://" + addr
+        url = f"{addr.rstrip('/')}/debug_verify_trace?spans={args.spans}"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                reply = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            # ValueError covers a non-JSON body (proxy error page,
+            # truncated response) — a diagnostic CLI must not traceback
+            print(f"cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+        if "error" in reply:
+            print(f"rpc error: {reply['error']}", file=sys.stderr)
+            return 1
+        doc = reply.get("result", {})
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    # human summary: health first, then the stage latency table
+    t = doc.get("tracing", {})
+    print(
+        "flight recorder: %s spans=%s dropped=%s anomalies=%s dumps=%s"
+        % (
+            "on" if t.get("enabled") else "OFF",
+            t.get("spans_recorded"),
+            t.get("spans_dropped"),
+            t.get("anomalies_total"),
+            t.get("dump_count"),
+        )
+    )
+    for kind, n in sorted((t.get("anomalies") or {}).items()):
+        print(f"  anomaly {kind}: {n}")
+    backend = doc.get("backend", {})
+    for name, br in sorted((backend.get("breakers") or {}).items()):
+        print(
+            "breaker %-12s %-9s opens=%s last_error=%s"
+            % (name, br.get("state"), br.get("opens"), br.get("last_error") or "-")
+        )
+    sig = doc.get("sigcache", {})
+    if sig:
+        print(
+            "sigcache: hit_rate=%.2f size=%s/%s"
+            % (sig.get("hit_rate", 0.0), sig.get("size"), sig.get("capacity"))
+        )
+    sched = doc.get("sched", {})
+    if sched:
+        print(
+            "sched: queue_depth=%s shed=%s dedup=%s"
+            % (
+                sched.get("queue_depth"),
+                sched.get("shed_total"),
+                sched.get("dedup_hits"),
+            )
+        )
+    warm = doc.get("warmboot", {})
+    if warm:
+        print(
+            "warmboot: runs=%s shapes=%s compiles=%s exec_hits=%s"
+            % (
+                warm.get("warm_runs"),
+                warm.get("shapes_warmed"),
+                warm.get("compiles"),
+                warm.get("exec_hits"),
+            )
+        )
+    stages = doc.get("stages", {})
+    if stages:
+        print(f"{'stage':24s} {'count':>7s} {'p50ms':>9s} {'p99ms':>9s} {'maxms':>9s}")
+        for stage, row in sorted(stages.items()):
+            print(
+                "%-24s %7d %9.3f %9.3f %9.3f"
+                % (
+                    stage,
+                    row["count"],
+                    row["p50_ms"],
+                    row["p99_ms"],
+                    row["max_ms"],
+                )
+            )
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """Reference: internal/inspect — read-only RPC over the data dir."""
     from cometbft_tpu.node.inspect import InspectNode
@@ -668,6 +766,26 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--json", action="store_true")
     sp.add_argument("--list", action="store_true", help="list scenarios")
     sp.set_defaults(fn=cmd_sim)
+
+    sp = sub.add_parser(
+        "trace",
+        help="snapshot the verify-pipeline flight recorder + health "
+        "(docs/observability.md)",
+    )
+    sp.add_argument(
+        "--rpc", default="tcp://127.0.0.1:26657",
+        help="node RPC address to query (default tcp://127.0.0.1:26657)",
+    )
+    sp.add_argument(
+        "--local", action="store_true",
+        help="render this process's own recorder instead of querying RPC",
+    )
+    sp.add_argument(
+        "--spans", type=int, default=256,
+        help="ring-tail spans to include (default 256)",
+    )
+    sp.add_argument("--json", action="store_true", help="raw JSON document")
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -872,13 +872,18 @@ class ConsensusState(BaseService):
         # is verified once per process, and on accelerator-backed nodes the
         # check coalesces with in-flight vote verifications
         from cometbft_tpu import verifysched
+        from cometbft_tpu.libs import tracing
 
-        if not verifysched.verify_cached(
-            proposer.pub_key,
-            proposal.sign_bytes(self.state.chain_id),
-            proposal.signature,
-            priority=verifysched.PRIO_CONSENSUS,
+        with tracing.span(
+            "consensus.proposal", h=proposal.height, r=proposal.round_
         ):
+            ok = verifysched.verify_cached(
+                proposer.pub_key,
+                proposal.sign_bytes(self.state.chain_id),
+                proposal.signature,
+                priority=verifysched.PRIO_CONSENSUS,
+            )
+        if not ok:
             raise VoteError("invalid proposal signature")
         rs.proposal = proposal
         rs.proposal_receive_time = self._clock()
@@ -1071,12 +1076,18 @@ class ConsensusState(BaseService):
         # extension signatures when serving/validating extended commits.
         # Scheduled at consensus priority: the extension check rides the
         # same fused dispatch as the vote signature it arrived with.
-        if not vote.extension_signature or not verifysched.verify_cached(
-            pub,
-            vote.extension_sign_bytes(self.state.chain_id),
-            vote.extension_signature,
-            priority=verifysched.PRIO_CONSENSUS,
+        from cometbft_tpu.libs import tracing
+
+        with tracing.span(
+            "consensus.vote_ext", h=vote.height, r=vote.round_
         ):
+            ext_ok = bool(vote.extension_signature) and verifysched.verify_cached(
+                pub,
+                vote.extension_sign_bytes(self.state.chain_id),
+                vote.extension_signature,
+                priority=verifysched.PRIO_CONSENSUS,
+            )
+        if not ext_ok:
             self.logger.debug(
                 "rejecting precommit: bad extension signature",
                 val=vote.validator_address.hex(),

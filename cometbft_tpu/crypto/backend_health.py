@@ -174,6 +174,7 @@ class CircuitBreaker:
                 self._repromotions += 1
 
     def record_failure(self, err: Optional[BaseException] = None) -> None:
+        opened = False
         with self._lock:
             self._failures += 1
             self._failures_total += 1
@@ -185,10 +186,23 @@ class CircuitBreaker:
             ):
                 self._state = OPEN
                 self._opens += 1
+                opened = True
                 self._open_until = self.clock() + self._backoff_s
                 # exponential backoff for the NEXT half-open window
                 self._backoff_s = min(self._backoff_s * 2, self.backoff_max_s)
             self._probe_inflight = False
+        if opened:
+            # flight-recorder anomaly (docs/observability.md), recorded
+            # OUTSIDE the breaker lock: the first open since reset dumps
+            # the span ring for postmortem
+            from cometbft_tpu.libs import tracing
+
+            tracing.record_anomaly(
+                "breaker_open",
+                backend=self.name,
+                opens=self._opens,
+                error=self._last_error,
+            )
 
     # -- introspection -----------------------------------------------------
 

@@ -1,0 +1,538 @@
+"""Verify-pipeline flight recorder: span tracing + anomaly forensics.
+
+The paper's headline claim is a LATENCY claim (<5 ms for a 10k-validator
+commit), but counters can only say that something was slow on average —
+not WHICH dispatch, at WHAT shape, on WHICH supervisor tier.  This module
+is the jax-free tracing half of the observability layer
+(docs/observability.md): a thread-safe span tracer over a bounded
+in-memory ring buffer (the *flight recorder*), threaded through the whole
+verify journey — txingest admission → sigcache probe → verifysched
+submit/queue-wait/flush → ``ops/verify`` bucket dispatch → supervisor tier
+(watchdog fires, degradations, bisect quarantines) → verdict resolution —
+plus the consensus/blocksync/light spans above it.
+
+Span model: ``(trace_id, span_id, parent_id, stage, t_start, t_end,
+attrs)``.  Trace propagation is ambient (a thread-local stack): a span
+opened while another is live becomes its child and inherits its trace id,
+so a commit verification's device dispatches attribute to the commit
+without any API threading.  The clock is injectable (``set_clock``) so
+the deterministic simulator traces on its VirtualClock and two same-seed
+runs produce byte-identical span streams.
+
+Stage taxonomy (dotted, coarse on the hot path — one span per batch or
+per dispatch, never per signature):
+
+  * ``txingest.flush`` / ``txingest.shed_sync``  — batched tx admission
+  * ``sched.flush`` / ``sched.shed_fallback``    — verify scheduler
+  * ``verify.commit``                            — commit verification
+    (consensus apply, blocksync frontier, light client)
+  * ``verify.batch`` / ``verify.dispatch``       — bucket dispatch (the
+    dispatch span carries bucket lanes + tier + dispatch seq: the triple
+    an anomaly dump attributes a watchdog fire to)
+  * ``supervisor.host_fallback`` / ``supervisor.bisect``
+  * ``consensus.vote`` / ``consensus.proposal`` / ``consensus.vote_ext``
+    (per height-round)
+  * ``blocksync.prefetch`` / ``light.chain``     — speculative windows
+  * ``warmboot.shape`` / ``warmboot.run``        — warm-boot progress
+
+Anomaly forensics: ``record_anomaly(kind, **attrs)`` counts every anomaly
+(watchdog_fire, breaker_open, queue_shed, ingest_shed, quarantine,
+exec_cache_stale) and — on the FIRST occurrence of each kind since the
+last reset (``COMETBFT_TPU_TRACE_DUMP_ALL=1`` dumps every occurrence) —
+writes the last ``COMETBFT_TPU_TRACE_DUMP_SPANS`` (256) spans as JSONL to
+``COMETBFT_TPU_TRACE_DIR`` for postmortem.  The dump's first line names
+the anomaly and its attributes; dump bytes are a pure function of the
+span stream, so a sim scenario's dump replays byte-identically per seed.
+
+Kill switch: ``COMETBFT_TPU_TRACE=0`` compiles spans down to no-ops (a
+shared null context manager; one env read per span site) — bench.py
+``--obs`` pins the disabled overhead at ≤1% of the sched bench.
+
+Deliberately free of jax imports, like ``ops/dispatch_stats``: the
+``/metrics`` scrape, the ``/debug/verify_trace`` RPC and the
+``cometbft-tpu trace`` CLI all read this module, and none of them may be
+the thing that initializes an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+logger = logging.getLogger("cometbft_tpu.tracing")
+
+DEFAULT_RING = 4096
+DEFAULT_DUMP_SPANS = 256
+# anomaly kinds with a dump trigger (docs/observability.md)
+ANOMALY_KINDS = (
+    "watchdog_fire",
+    "breaker_open",
+    "queue_shed",
+    "ingest_shed",
+    "quarantine",
+    "exec_cache_stale",
+)
+
+
+def enabled() -> bool:
+    """``COMETBFT_TPU_TRACE=0`` is the kill switch; default on.  One dict
+    lookup — the only cost a disabled span site pays besides the null
+    context manager."""
+    return os.environ.get("COMETBFT_TPU_TRACE", "1") != "0"
+
+
+def trace_dir() -> Optional[str]:
+    return os.environ.get("COMETBFT_TPU_TRACE_DIR") or None
+
+
+class Span:
+    """One recorded stage interval.  ``attrs`` values must be
+    JSON-serializable (dump files are byte-compared across sim runs)."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "stage", "t_start", "t_end",
+        "attrs",
+    )
+
+    def __init__(self, trace_id, span_id, parent_id, stage, t_start, attrs):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.stage = stage
+        self.t_start = t_start
+        self.t_end = None
+        self.attrs = attrs
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes mid-span (e.g. the outcome, known at exit)."""
+        self.attrs.update(attrs)
+        return self
+
+    @property
+    def duration(self) -> float:
+        return (self.t_end or self.t_start) - self.t_start
+
+    def to_dict(self) -> dict:
+        # fixed rounding so float formatting can never vary a dump byte
+        d = {
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "stage": self.stage,
+            "t0": round(self.t_start, 9),
+            "t1": round(self.t_end, 9) if self.t_end is not None else None,
+            "dur_ms": (
+                round((self.t_end - self.t_start) * 1e3, 6)
+                if self.t_end is not None
+                else None
+            ),
+        }
+        if self.parent_id is not None:
+            d["parent"] = self.parent_id
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class _NullSpan:
+    """The disabled-tracer span: a shared, allocation-free no-op that
+    still satisfies the ``with tracer.span(...) as sp: sp.set(...)``
+    calling convention."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    __slots__ = ("tracer", "sp")
+
+    def __init__(self, tracer: "Tracer", sp: Span):
+        self.tracer = tracer
+        self.sp = sp
+
+    def __enter__(self) -> Span:
+        stack = self.tracer._stack()
+        stack.append(self.sp)
+        return self.sp
+
+    def __exit__(self, etype, evalue, tb) -> bool:
+        sp = self.sp
+        sp.t_end = self.tracer._clock()
+        if etype is not None:
+            sp.attrs.setdefault("error", etype.__name__)
+        stack = self.tracer._stack()
+        if stack and stack[-1] is sp:
+            stack.pop()
+        elif sp in stack:  # mis-nested exit (exception unwound past us)
+            stack.remove(sp)
+        self.tracer._append(sp)
+        return False
+
+
+class Tracer:
+    """Bounded flight recorder; all methods are thread-safe.
+
+    Spans land in the ring ON COMPLETION (the append is the caller
+    thread's, so a worker abandoned by the dispatch watchdog never races
+    a span into a deterministic sim's record)."""
+
+    def __init__(
+        self,
+        ring_size: Optional[int] = None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        if ring_size is None:
+            try:
+                ring_size = int(
+                    os.environ.get("COMETBFT_TPU_TRACE_RING", "")
+                    or DEFAULT_RING
+                )
+            except ValueError:
+                ring_size = DEFAULT_RING
+        self._lock = threading.Lock()
+        self._ring: "deque[Span]" = deque(maxlen=max(int(ring_size), 16))
+        self._clock: Callable[[], float] = clock or time.perf_counter
+        self._tls = threading.local()
+        self._next_id = 1
+        self._recorded = 0
+        self._dropped = 0
+        self._anomalies: dict = {}
+        self._dumped_kinds: set = set()
+        self._dump_seq = 0
+        self._dumps: "list[str]" = []
+        self._overhead_s = 0.0
+        # process-LIFETIME aggregates: reset() (sim per-run hygiene) does
+        # not clear these, so the tier1-trace summary line still reports
+        # the whole test run's span volume and recorder overhead
+        self._life_recorded = 0
+        self._life_dropped = 0
+        self._life_anomalies = 0
+        self._life_dumps = 0
+        self._life_overhead_s = 0.0
+
+    # -- span API ----------------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def span(self, stage: str, **attrs):
+        """Context manager recording one stage interval.  Nested spans
+        (same thread) become children; the root span's id is the trace id.
+        Disabled tracer → the shared no-op span."""
+        if not enabled():
+            return _NULL_SPAN
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        sp = Span(
+            trace_id=parent.trace_id if parent is not None else sid,
+            span_id=sid,
+            parent_id=parent.span_id if parent is not None else None,
+            stage=stage,
+            t_start=self._clock(),
+            attrs=attrs,
+        )
+        return _SpanCtx(self, sp)
+
+    def current_trace(self) -> Optional[int]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1].trace_id if stack else None
+
+    def _append(self, sp: Span) -> None:
+        t0 = time.perf_counter()
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+                self._life_dropped += 1
+            self._ring.append(sp)
+            self._recorded += 1
+            self._life_recorded += 1
+            # exit-path cost only (the enter path is of the same order):
+            # an approximate but honestly *measured* recorder overhead the
+            # tier1-trace summary line reports as a share of wall time
+            dt = time.perf_counter() - t0
+            self._overhead_s += dt
+            self._life_overhead_s += dt
+
+    # -- anomaly forensics -------------------------------------------------
+
+    def record_anomaly(self, kind: str, **attrs) -> Optional[str]:
+        """Count an anomaly; dump the ring tail as JSONL on the first
+        occurrence of ``kind`` since the last reset (all occurrences with
+        ``COMETBFT_TPU_TRACE_DUMP_ALL=1``).  Returns the dump path, or
+        None when no dump was written.  Never raises — forensics must not
+        become a second failure."""
+        dump_all = os.environ.get("COMETBFT_TPU_TRACE_DUMP_ALL") == "1"
+        with self._lock:
+            self._anomalies[kind] = self._anomalies.get(kind, 0) + 1
+            self._life_anomalies += 1
+            want_dump = (
+                enabled()
+                and trace_dir() is not None
+                and (dump_all or kind not in self._dumped_kinds)
+            )
+            if not want_dump:
+                return None
+            self._dumped_kinds.add(kind)
+            self._dump_seq += 1
+            seq = self._dump_seq
+            tail = self._dump_tail_locked()
+            now = self._clock()
+        try:
+            return self._write_dump(kind, seq, now, attrs, tail)
+        except Exception as e:  # noqa: BLE001 — forensics is best-effort
+            logger.warning("flight-recorder dump failed: %r", e)
+            return None
+
+    def _dump_tail_locked(self) -> "list[Span]":
+        try:
+            n = int(
+                os.environ.get("COMETBFT_TPU_TRACE_DUMP_SPANS", "")
+                or DEFAULT_DUMP_SPANS
+            )
+        except ValueError:
+            n = DEFAULT_DUMP_SPANS
+        ring = list(self._ring)
+        return ring[-n:] if n > 0 else ring
+
+    def _write_dump(self, kind, seq, now, attrs, tail) -> str:
+        d = trace_dir()
+        os.makedirs(d, exist_ok=True)
+        name = f"trace-{seq:03d}-{kind}.jsonl"
+        path = os.path.join(d, name)
+        lines = [
+            json.dumps(
+                {
+                    "anomaly": kind,
+                    "seq": seq,
+                    "t": round(now, 9),
+                    "attrs": attrs,
+                    "spans": len(tail),
+                },
+                sort_keys=True,
+            )
+        ]
+        lines.extend(json.dumps(sp.to_dict(), sort_keys=True) for sp in tail)
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with self._lock:
+            self._dumps.append(name)
+            del self._dumps[:-32]  # keep the last 32 names
+            self._life_dumps += 1
+        logger.warning(
+            "flight recorder: anomaly %s -> dumped %d spans to %s",
+            kind,
+            len(tail),
+            path,
+        )
+        return path
+
+    # -- introspection -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": enabled(),
+                "ring_size": self._ring.maxlen,
+                "ring_len": len(self._ring),
+                "spans_recorded": self._recorded,
+                "spans_dropped": self._dropped,
+                "anomalies": dict(self._anomalies),
+                "anomalies_total": sum(self._anomalies.values()),
+                "dumps": list(self._dumps),
+                "dump_count": self._dump_seq,
+                "overhead_seconds": self._overhead_s,
+                "lifetime": {
+                    "spans_recorded": self._life_recorded,
+                    "spans_dropped": self._life_dropped,
+                    "anomalies": self._life_anomalies,
+                    "dumps": self._life_dumps,
+                    "overhead_seconds": self._life_overhead_s,
+                },
+            }
+
+    def tail(self, n: int = DEFAULT_DUMP_SPANS) -> "list[dict]":
+        with self._lock:
+            ring = list(self._ring)
+        return [sp.to_dict() for sp in (ring[-n:] if n > 0 else ring)]
+
+    def stage_summary(self) -> dict:
+        """Per-stage count / total / p50 / p99 over the spans currently in
+        the ring (bounded by the ring, so the percentiles describe the
+        recent window — exactly what a regression hunt wants)."""
+        with self._lock:
+            ring = list(self._ring)
+        by_stage: dict = {}
+        for sp in ring:
+            if sp.t_end is None:
+                continue
+            by_stage.setdefault(sp.stage, []).append(sp.t_end - sp.t_start)
+        out = {}
+        for stage, durs in sorted(by_stage.items()):
+            durs.sort()
+            n = len(durs)
+            out[stage] = {
+                "count": n,
+                "total_ms": round(sum(durs) * 1e3, 3),
+                "p50_ms": round(durs[n // 2] * 1e3, 3),
+                "p99_ms": round(durs[min(n - 1, (n * 99) // 100)] * 1e3, 3),
+                "max_ms": round(durs[-1] * 1e3, 3),
+            }
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def set_clock(self, clock: Optional[Callable[[], float]]) -> None:
+        """Swap the time source (the sim pins its VirtualClock here so
+        span times are virtual and deterministic); None restores
+        ``time.perf_counter``."""
+        self._clock = clock or time.perf_counter
+
+    def reset(self) -> None:
+        """Fresh recorder state: empty ring, zeroed counters/ids, dump
+        latch cleared.  The sim calls this per scenario run so span ids
+        (and therefore dump bytes) are a pure function of the seed."""
+        with self._lock:
+            self._ring.clear()
+            self._next_id = 1
+            self._recorded = 0
+            self._dropped = 0
+            self._anomalies = {}
+            self._dumped_kinds = set()
+            self._dump_seq = 0
+            self._dumps = []
+            self._overhead_s = 0.0
+
+
+_TRACER: Optional[Tracer] = None
+_TRACER_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide flight recorder (every pipeline stage writes to
+    one ring — cross-stage attribution IS the feature)."""
+    global _TRACER
+    if _TRACER is None:
+        with _TRACER_LOCK:
+            if _TRACER is None:
+                _TRACER = Tracer()
+    return _TRACER
+
+
+def reset_tracer() -> None:
+    """Drop the process-wide tracer (tests/sim; re-reads the ring-size
+    env on next use)."""
+    global _TRACER
+    with _TRACER_LOCK:
+        _TRACER = None
+
+
+# module-level conveniences — the spelling the pipeline call sites use
+def span(stage: str, **attrs):
+    return get_tracer().span(stage, **attrs)
+
+
+def record_anomaly(kind: str, **attrs) -> Optional[str]:
+    return get_tracer().record_anomaly(kind, **attrs)
+
+
+def summary_line() -> str:
+    """One parseable line for test logs (scripts/check_tier1_budget.py
+    reads the span count and recorder overhead share from it).  Reports
+    the process-LIFETIME aggregates: per-run ``reset()`` calls (the sim)
+    must not hide the suite's true recorder traffic."""
+    life = get_tracer().snapshot()["lifetime"]
+    return (
+        "tier1-trace: spans=%d dropped=%d anomalies=%d dumps=%d "
+        "overhead_s=%.3f"
+        % (
+            life["spans_recorded"],
+            life["spans_dropped"],
+            life["anomalies"],
+            life["dumps"],
+            life["overhead_seconds"],
+        )
+    )
+
+
+def trace_document(max_spans: int = DEFAULT_DUMP_SPANS) -> dict:
+    """The one-call forensic snapshot behind the ``/debug/verify_trace``
+    RPC and the ``cometbft-tpu trace`` CLI: ring tail + per-stage latency
+    summary + pipeline health (breaker states, cache hit rates, scheduler
+    queue, warm-boot progress) as a single JSON-serializable document.
+
+    Every read is lazy and jax-free; a section that fails to import
+    reports its error instead of sinking the document."""
+    tracer = get_tracer()
+    doc = {
+        "tracing": tracer.snapshot(),
+        "stages": tracer.stage_summary(),
+        # max_spans <= 0 really means "health only, no span payload" —
+        # tail()'s 0-means-all convention is for the dump path, not here
+        "spans": tracer.tail(max_spans) if max_spans > 0 else [],
+    }
+
+    def section(name, fn):
+        try:
+            doc[name] = fn()
+        except Exception as e:  # noqa: BLE001 — one bad section must not
+            # sink the whole forensic document
+            doc[name] = {"error": repr(e)}
+
+    def _backend():
+        from cometbft_tpu.crypto import backend_health
+
+        return backend_health.snapshot()
+
+    def _sigcache():
+        from cometbft_tpu.crypto import sigcache
+
+        return sigcache.get_cache().stats()
+
+    def _dispatch():
+        from cometbft_tpu.ops import dispatch_stats
+
+        return dispatch_stats.snapshot()
+
+    def _sched():
+        from cometbft_tpu.verifysched import stats as sstats
+
+        return sstats.snapshot()
+
+    def _warmboot():
+        from cometbft_tpu.ops import warm_stats
+
+        return warm_stats.snapshot()
+
+    def _ingest():
+        from cometbft_tpu.txingest import stats as istats
+
+        return istats.snapshot()
+
+    section("backend", _backend)
+    section("sigcache", _sigcache)
+    section("dispatch", _dispatch)
+    section("sched", _sched)
+    section("warmboot", _warmboot)
+    section("ingest", _ingest)
+    return doc

@@ -211,7 +211,15 @@ def verify_adjacent_chain(
         for p, _, miss in per_header
         if miss
     ]
-    fresh = iter(ov.verify_batches_overlapped(work) if work else [])
+    from cometbft_tpu.libs import tracing
+
+    with tracing.span(
+        "light.chain",
+        headers=len(news),
+        h0=news[0].height,
+        sigs=sum(len(m) for _, _, m in per_header),
+    ):
+        fresh = iter(ov.verify_batches_overlapped(work) if work else [])
 
     # judge strictly in order
     for p, bits, miss in per_header:

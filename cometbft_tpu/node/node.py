@@ -508,11 +508,18 @@ class Node(BaseService):
                 target=self._tx_waiter, daemon=True
             )
             self._tx_waiter_thread.start()
+        # flight-recorder state belongs in the boot log: when a postmortem
+        # needs a dump, the first question is whether tracing was on and
+        # where dumps land (docs/observability.md)
+        from cometbft_tpu.libs import tracing
+
         self.logger.info(
             "node started",
             node_id=self.node_key.node_id,
             chain_id=self.genesis_doc.chain_id,
             height=self.state.last_block_height,
+            flight_recorder="on" if tracing.enabled() else "off",
+            trace_dir=tracing.trace_dir() or "",
         )
 
     def _run_statesync(self) -> None:

@@ -217,6 +217,14 @@ def load(tag: str):
         if "Symbols not found" in str(e):
             _NO_ROUNDTRIP[0] = True
         warm_stats.record_stale()
+        # flight-recorder anomaly (docs/observability.md): a stale read
+        # means the hot path is about to pay a recompile it expected to
+        # skip — postmortems want the spans that led here
+        from cometbft_tpu.libs import tracing
+
+        tracing.record_anomaly(
+            "exec_cache_stale", tag=tag, error=type(e).__name__
+        )
         return None, {"exec_cache": f"stale:{type(e).__name__}"}
 
 

@@ -93,6 +93,29 @@ def _batch_mul_kernel(px, py, pz, bits):
     return scalar_mul(G1(px, py, pz), bits)
 
 
+def warm_kernels(b: int, nbits: int = 128) -> "dict[str, dict]":
+    """Resolve (load or AOT-compile + persist) the G1 msm / sum /
+    batch-mul executables for lane shape ``b`` WITHOUT dispatching them —
+    the warm-boot pass (docs/warm-boot.md) walks this over the BLS matrix
+    so vote-extension and light-attack aggregate checks meet resident
+    executables.  Tags mirror the ``cached_call`` sites above exactly.
+    Returns {tag: exec-cache info}."""
+    from cometbft_tpu.ops import aot_cache
+
+    p = pack_points([None] * b)
+    lanes = p.x.v.shape[1]
+    bits = jnp.asarray(pack_scalar_bits([0] * b, nbits, lanes))
+    out = {}
+    for kernel, args, tag in (
+        (_msm_kernel, (p.x, p.y, p.z, bits), f"bls-msm-{lanes}x{nbits}"),
+        (_sum_kernel, (p.x, p.y, p.z), f"bls-sum-{lanes}"),
+        (_batch_mul_kernel, (p.x, p.y, p.z, bits), f"bls-mul-{lanes}x{nbits}"),
+    ):
+        _, info = aot_cache.load_or_compile(kernel, args, tag)
+        out[tag] = info
+    return out
+
+
 def batch_scalar_mul(points: Sequence[Optional[tuple]],
                      scalars: Sequence[int], nbits: int = 128) -> list:
     """Host API: per-lane [scalarᵢ·pointᵢ] (no lane sum) — the shape the

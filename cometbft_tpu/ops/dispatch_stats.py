@@ -13,22 +13,42 @@ Counters:
   * ``fused_segments``   — segments that rode in a fused dispatch
   * ``verify_calls`` / ``verify_seconds`` — commit-verification latency
     aggregate (observed by types/validation)
+
+Histograms (docs/observability.md) — real distributions on /metrics, not
+just cumulative sums:
+  * ``buckets[lanes]``           — dispatch count per padding bucket (the
+    per-bucket histogram the bucket-ladder pruning decisions read)
+  * ``dispatch_hist[tier-lanes]`` — device dispatch WALL time per
+    (supervisor tier, padding bucket): a sick lane is attributable to a
+    shape and a tier from one scrape
+  * ``verify_hist``              — commit verification latency
 """
 
 from __future__ import annotations
 
 import threading
 
+from cometbft_tpu.libs.histo import DISPATCH_BUCKETS_S, Histo
+
 _LOCK = threading.Lock()
-_STATS = {
-    "dispatches": 0,
-    "lanes_total": 0,
-    "lanes_used": 0,
-    "fused_batches": 0,
-    "fused_segments": 0,
-    "verify_calls": 0,
-    "verify_seconds": 0.0,
-}
+
+
+def _zero() -> dict:
+    return {
+        "dispatches": 0,
+        "lanes_total": 0,
+        "lanes_used": 0,
+        "fused_batches": 0,
+        "fused_segments": 0,
+        "verify_calls": 0,
+        "verify_seconds": 0.0,
+        "buckets": {},  # lanes -> dispatch count
+        "dispatch_hist": {},  # "tier-lanes" -> Histo (wall seconds)
+        "verify_hist": Histo(),
+    }
+
+
+_STATS = _zero()
 
 
 def record_dispatch(lanes_total: int, lanes_used: int) -> None:
@@ -36,6 +56,20 @@ def record_dispatch(lanes_total: int, lanes_used: int) -> None:
         _STATS["dispatches"] += 1
         _STATS["lanes_total"] += int(lanes_total)
         _STATS["lanes_used"] += int(lanes_used)
+        b = _STATS["buckets"]
+        b[int(lanes_total)] = b.get(int(lanes_total), 0) + 1
+
+
+def record_dispatch_time(impl: str, lanes: int, seconds: float) -> None:
+    """Wall time of one device dispatch (dispatch + fetch), keyed by
+    (supervisor tier, padding bucket) — written by the supervisor's
+    dispatch path and the raw ``verify_batch`` fallback."""
+    key = f"{impl}-{int(lanes)}"
+    with _LOCK:
+        h = _STATS["dispatch_hist"].get(key)
+        if h is None:
+            h = _STATS["dispatch_hist"][key] = Histo(DISPATCH_BUCKETS_S)
+        h.observe(float(seconds))
 
 
 def record_fused(n_segments: int) -> None:
@@ -48,6 +82,7 @@ def record_verify_latency(seconds: float) -> None:
     with _LOCK:
         _STATS["verify_calls"] += 1
         _STATS["verify_seconds"] += float(seconds)
+        _STATS["verify_hist"].observe(float(seconds))
 
 
 def dispatch_count() -> int:
@@ -57,7 +92,17 @@ def dispatch_count() -> int:
 
 def snapshot() -> dict:
     with _LOCK:
-        out = dict(_STATS)
+        out = {}
+        for k, v in _STATS.items():
+            if isinstance(v, Histo):
+                out[k] = v.to_dict()
+            elif isinstance(v, dict):
+                out[k] = {
+                    kk: (vv.to_dict() if isinstance(vv, Histo) else vv)
+                    for kk, vv in v.items()
+                }
+            else:
+                out[k] = v
     out["occupancy"] = (
         out["lanes_used"] / out["lanes_total"] if out["lanes_total"] else 0.0
     )
@@ -65,6 +110,6 @@ def snapshot() -> dict:
 
 
 def reset() -> None:
+    global _STATS
     with _LOCK:
-        for k in _STATS:
-            _STATS[k] = 0.0 if k == "verify_seconds" else 0
+        _STATS = _zero()

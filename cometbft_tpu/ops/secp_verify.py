@@ -121,6 +121,31 @@ def _ladder_kernel(gx, gy, gz, qx, qy, qz, u1_bits, u2_bits):
     return r.x.v, r.y.v, r.z.v
 
 
+def ladder_tag(b: int) -> str:
+    """Exec-cache tag for one ladder batch shape (shared with the warm
+    pass in ``ops/warmboot`` — the tag strings must never diverge from
+    ``verify_batch``'s ``cached_call`` below)."""
+    return f"secp-ladder-{b}x{NBITS}"
+
+
+def warm_ladder(b: int) -> dict:
+    """Resolve (load or AOT-compile + persist) the ladder executable for
+    batch shape ``b`` WITHOUT dispatching it — the warm-boot pass
+    (docs/warm-boot.md) walks this over the secp matrix so the first real
+    ECDSA batch meets a resident executable.  Returns the exec-cache
+    info dict (``hit`` / ``memo`` / ``compile_s`` + persisted)."""
+    from cometbft_tpu.ops import aot_cache
+
+    g = _packed_generator(b)
+    bits = jnp.asarray(pack_scalar_bits([0] * b, NBITS, b))
+    _, info = aot_cache.load_or_compile(
+        _ladder_kernel,
+        (g.x, g.y, g.z, g.x, g.y, g.z, bits, bits),
+        ladder_tag(b),
+    )
+    return info
+
+
 def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
                  sigs: Sequence[bytes]) -> np.ndarray:
     """(n,) bool accept bits — per-lane independent ECDSA verification."""
@@ -146,7 +171,7 @@ def verify_batch(pubs: Sequence[bytes], msgs: Sequence[bytes],
     xs, ys, zs = aot_cache.cached_call(
         _ladder_kernel,
         (g.x, g.y, g.z, q.x, q.y, q.z, u1_bits, u2_bits),
-        f"secp-ladder-{b}x{NBITS}",
+        ladder_tag(b),
     )
     # host post: affine x, compare mod n (bigints; only the raw limbs
     # matter to fpgen.unpack — the bounds on the template are unused)

@@ -58,6 +58,7 @@ from cometbft_tpu.crypto.backend_health import (
     BackendOutputError,
     DispatchTimeoutError,
 )
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.ops import dispatch_stats
 
 logger = logging.getLogger("cometbft_tpu.crypto")
@@ -256,10 +257,17 @@ class _Watchdog:
 _WATCHDOG = _Watchdog()
 
 
-def watchdog_call(fn: Callable, timeout_s: Optional[float] = None, backend: str = ""):
+def watchdog_call(
+    fn: Callable,
+    timeout_s: Optional[float] = None,
+    backend: str = "",
+    note_anomaly: bool = True,
+):
     """Run ``fn`` under the dispatch watchdog.  This is the seam the
     secp256k1/BLS device paths share: any device call a consensus thread
-    must survive goes through here."""
+    must survive goes through here.  A fire lands in the flight recorder
+    (``note_anomaly=False`` for callers that record their own with richer
+    attribution, like ``_attempt``'s bucket/dispatch attrs)."""
     t = dispatch_timeout_s() if timeout_s is None else timeout_s
     if not t or t <= 0:
         return fn()
@@ -267,7 +275,27 @@ def watchdog_call(fn: Callable, timeout_s: Optional[float] = None, backend: str 
         return _WATCHDOG.call(fn, t)
     except DispatchTimeoutError:
         backend_health.registry().record_watchdog_fire(backend)
+        if note_anomaly:
+            tracing.record_anomaly("watchdog_fire", tier=backend)
         raise
+
+
+def _profile_ctx():
+    """Optional on-device profiler capture (``COMETBFT_TPU_PROFILE_DIR``):
+    wraps one supervised dispatch in ``jax.profiler.trace`` so the
+    perfetto trace of the actual kernel schedule lands next to the flight
+    recorder's host-side spans.  Returns a context manager or None; any
+    profiler failure (nested capture, missing backend) degrades to an
+    unprofiled dispatch — profiling must never fail a verify."""
+    d = os.environ.get("COMETBFT_TPU_PROFILE_DIR")
+    if not d:
+        return None
+    try:
+        import jax
+
+        return jax.profiler.trace(d)
+    except Exception:  # noqa: BLE001 — profiling is never load-bearing
+        return None
 
 
 def supervised_device_call(
@@ -325,7 +353,13 @@ def _validate_accept(accept, lanes: int) -> np.ndarray:
 def _attempt(backend: str, pubs, msgs, sigs) -> np.ndarray:
     """One supervised dispatch on one device backend.  Raises
     ``DispatchTimeoutError`` / ``BackendOutputError`` / whatever the kernel
-    raised; never returns partial results."""
+    raised; never returns partial results.
+
+    The dispatch SPAN is recorded on the CALLING thread around
+    ``watchdog_call`` — never by the worker — so an abandoned (wedged)
+    worker can't race a late span into a deterministic sim's flight
+    record.  It carries the (tier, lanes, dispatch-seq) triple an anomaly
+    dump attributes a watchdog fire to."""
     import jax.numpy as jnp
 
     from cometbft_tpu.ops import verify as ov
@@ -335,6 +369,9 @@ def _attempt(backend: str, pubs, msgs, sigs) -> np.ndarray:
     lanes = arrays["s_ok"].shape[0]
     inj = _FAULT_INJECTOR
     runner = _DEVICE_RUNNER
+    # the ordinal this dispatch will record (single dispatch in flight per
+    # attempt; concurrent attempts only skew the label, never the verdict)
+    seq = dispatch_stats.dispatch_count() + 1
 
     def run():
         transform = inj(backend, pubs, msgs, sigs) if inj is not None else None
@@ -347,14 +384,49 @@ def _attempt(backend: str, pubs, msgs, sigs) -> np.ndarray:
             # like a wedged dispatch, and the device-runner seam above
             # never pays a compile at all
             call, _ = ov.bucket_executable(backend, lanes)
-            out = np.asarray(
-                call(**{k: jnp.asarray(v) for k, v in arrays.items()})
-            )
+            # jax.profiler.trace raises at __enter__ on a collision
+            # ("profile already in progress" — concurrent dispatches), so
+            # the enter itself must be guarded or a profiling collision
+            # would read as a backend failure and demote a healthy tier
+            prof = _profile_ctx()
+            entered = False
+            if prof is not None:
+                try:
+                    prof.__enter__()
+                    entered = True
+                except Exception:  # noqa: BLE001 — never fail a verify
+                    prof = None
+            try:
+                out = np.asarray(
+                    call(**{k: jnp.asarray(v) for k, v in arrays.items()})
+                )
+            finally:
+                if entered:
+                    try:
+                        prof.__exit__(None, None, None)
+                    except Exception:  # noqa: BLE001 — profiling only
+                        pass
         if transform is not None:
             out = transform(out)
         return out
 
-    accept = watchdog_call(run, backend=backend)
+    t0 = time.perf_counter()
+    try:
+        with tracing.span(
+            "verify.dispatch", tier=backend, lanes=lanes, n=n, dispatch=seq
+        ):
+            accept = watchdog_call(run, backend=backend, note_anomaly=False)
+    except DispatchTimeoutError:
+        # the failed span is already in the ring (the with-block closed),
+        # so the dump this triggers shows it as its most recent entry
+        tracing.record_anomaly(
+            "watchdog_fire", tier=backend, lanes=lanes, n=n, dispatch=seq
+        )
+        raise
+    finally:
+        dispatch_stats.record_dispatch_time(
+            backend, lanes, time.perf_counter() - t0
+        )
     return (_validate_accept(accept, lanes) & structural)[:n]
 
 
@@ -368,11 +440,12 @@ def host_verify(pubs, msgs, sigs) -> np.ndarray:
     n = len(pubs)
     if n:
         backend_health.registry().record_fallback(n)
-    return np.fromiter(
-        (ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
-        dtype=bool,
-        count=n,
-    )
+    with tracing.span("supervisor.host_fallback", n=n):
+        return np.fromiter(
+            (ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
+            dtype=bool,
+            count=n,
+        )
 
 
 class _GiveUp(Exception):
@@ -420,7 +493,9 @@ def _bisect_quarantine(
             return solve(lo, mid) + solve(mid, hi)
 
     try:
-        bits = np.asarray(solve(0, n), dtype=bool)
+        with tracing.span("supervisor.bisect", tier=backend, n=n) as sp:
+            bits = np.asarray(solve(0, n), dtype=bool)
+            sp.set(quarantined=quarantined[0])
     except _GiveUp:
         return None
     # record only on commit: an abandoned bisect (systematic failure) must
@@ -428,6 +503,7 @@ def _bisect_quarantine(
     if quarantined[0]:
         reg.record_quarantine(backend)
         reg.record_fallback(1)
+        tracing.record_anomaly("quarantine", tier=backend, n=n)
         logger.warning(
             "crypto backend %s: quarantined poisoned input "
             "(kills the kernel; host-verified instead)",
@@ -447,40 +523,45 @@ def verify_supervised(pubs, msgs, sigs, skip: tuple = ()) -> np.ndarray:
     pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
     n = len(pubs)
     reg = backend_health.registry()
-    for backend in device_chain():
-        if backend in skip:
-            continue
-        br = reg.breaker(backend)
-        if not br.allow():
-            continue
-        try:
-            bits = _attempt(backend, pubs, msgs, sigs)
-        except Exception as e:  # noqa: BLE001 — any dispatch error demotes
-            if (
-                n >= 2
-                and _bisect_enabled()
-                and not isinstance(e, DispatchTimeoutError)
-                and br.stats()["consecutive_failures"] == 0
-            ):
-                try:
-                    solved = _bisect_quarantine(backend, pubs, msgs, sigs)
-                except Exception:  # noqa: BLE001 — bisect is best-effort
-                    solved = None
-                if solved is not None:
-                    br.record_success()
-                    return solved
-            br.record_failure(e)
-            reg.record_demotion(backend)
-            logger.warning(
-                "crypto backend %s dispatch failed (%r); retrying on the "
-                "next verify tier",
-                backend,
-                e,
-            )
-            continue
-        br.record_success()
-        return bits
-    return host_verify(pubs, msgs, sigs)
+    with tracing.span("verify.batch", n=n) as vsp:
+        for backend in device_chain():
+            if backend in skip:
+                continue
+            br = reg.breaker(backend)
+            if not br.allow():
+                continue
+            try:
+                bits = _attempt(backend, pubs, msgs, sigs)
+            except Exception as e:  # noqa: BLE001 — any dispatch error
+                # demotes
+                if (
+                    n >= 2
+                    and _bisect_enabled()
+                    and not isinstance(e, DispatchTimeoutError)
+                    and br.stats()["consecutive_failures"] == 0
+                ):
+                    try:
+                        solved = _bisect_quarantine(backend, pubs, msgs, sigs)
+                    except Exception:  # noqa: BLE001 — bisect best-effort
+                        solved = None
+                    if solved is not None:
+                        br.record_success()
+                        vsp.set(tier=backend, bisected=True)
+                        return solved
+                br.record_failure(e)
+                reg.record_demotion(backend)
+                logger.warning(
+                    "crypto backend %s dispatch failed (%r); retrying on "
+                    "the next verify tier",
+                    backend,
+                    e,
+                )
+                continue
+            br.record_success()
+            vsp.set(tier=backend)
+            return bits
+        vsp.set(tier=HOST_BACKEND)
+        return host_verify(pubs, msgs, sigs)
 
 
 def verify_batches_overlapped_supervised(work) -> list:
@@ -504,7 +585,10 @@ def verify_batches_overlapped_supervised(work) -> list:
             break
     if backend is None:
         # fully degraded: per-batch host verification, no device to overlap
-        return [host_verify(*w) for w in work]
+        with tracing.span(
+            "verify.window", batches=len(work), tier=HOST_BACKEND
+        ):
+            return [host_verify(*w) for w in work]
     br = reg.breaker(backend)
     min_b = ov._PALLAS_MIN_BUCKET if backend == "pallas" else ov._BUCKETS[0]
 
@@ -535,7 +619,11 @@ def verify_batches_overlapped_supervised(work) -> list:
             )
 
         try:
-            dev, transform = watchdog_call(dispatch, backend=backend)
+            with tracing.span(
+                "verify.dispatch", tier=backend, lanes=lanes, n=n,
+                window=len(work),
+            ):
+                dev, transform = watchdog_call(dispatch, backend=backend)
         except Exception as e:  # noqa: BLE001
             br.record_failure(e)
             reg.record_demotion(backend)
@@ -566,9 +654,15 @@ def verify_batches_overlapped_supervised(work) -> list:
             return transform(a) if transform is not None else a
 
         try:
-            accept = _validate_accept(
-                watchdog_call(fetch, backend=backend), lanes
+            t0 = time.perf_counter()
+            with tracing.span(
+                "verify.fetch", tier=backend, lanes=lanes, n=n
+            ):
+                got = watchdog_call(fetch, backend=backend)
+            dispatch_stats.record_dispatch_time(
+                backend, lanes, time.perf_counter() - t0
             )
+            accept = _validate_accept(got, lanes)
         except Exception as e:  # noqa: BLE001
             br.record_failure(e)
             reg.record_demotion(backend)

@@ -24,6 +24,7 @@ import contextlib
 import hashlib
 import os
 import threading
+import time
 import warnings
 from typing import Optional, Sequence
 
@@ -38,6 +39,7 @@ warnings.filterwarnings(
     "ignore", message="Some donated buffers were not usable"
 )
 
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.ops import dispatch_stats
 from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import ed25519_point as ep
@@ -419,8 +421,16 @@ def verify_batch(
     if supervisor.enabled():
         return supervisor.verify_supervised(pubs, msgs, sigs)
     arrays, n, structural = prepare_batch(pubs, msgs, sigs, _min_bucket())
-    dispatch_stats.record_dispatch(arrays["s_ok"].shape[0], n)
-    accept = np.asarray(_dispatch_bucket(arrays, select_impl()))
+    impl = select_impl()
+    lanes = arrays["s_ok"].shape[0]
+    dispatch_stats.record_dispatch(lanes, n)
+    seq = dispatch_stats.dispatch_count()
+    t0 = time.perf_counter()
+    with tracing.span(
+        "verify.dispatch", tier=impl, lanes=lanes, n=n, dispatch=seq
+    ):
+        accept = np.asarray(_dispatch_bucket(arrays, impl))
+    dispatch_stats.record_dispatch_time(impl, lanes, time.perf_counter() - t0)
     return (accept & structural)[:n]
 
 

@@ -47,6 +47,15 @@ _LOCK = threading.Lock()
 _THREAD: "list[Optional[threading.Thread]]" = [None]
 _DONE = threading.Event()  # a pass COMPLETED in this process
 
+# secp256k1 ladder / BLS G1 shapes warmed alongside the ed25519 buckets
+# (ROADMAP item 4 follow-up: these used to compile on first use).  Sizes
+# are batch lanes (padded to powers of two by their kernels); the defaults
+# cover the envelope/evidence/aggregate traffic the verifiers actually
+# see.  COMETBFT_TPU_WARMBOOT_SECP_BUCKETS / _BLS_BUCKETS override —
+# an EMPTY value skips that family entirely.
+DEFAULT_SECP_BUCKETS = (1, 2, 4, 8)
+DEFAULT_BLS_BUCKETS = (2, 4, 8)
+
 
 def enabled() -> bool:
     """Explicit ``COMETBFT_TPU_WARMBOOT`` wins; otherwise default on for
@@ -68,6 +77,50 @@ def _env_buckets() -> "Optional[list[int]]":
         return sorted({int(x) for x in raw.split(",") if x.strip()})
     except ValueError:
         return None
+
+
+def _env_sizes(name: str, default) -> "list[int]":
+    """Like ``_env_buckets`` but for the secp/BLS families: unset ->
+    the default matrix, an explicitly EMPTY value -> [] (skip family)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return sorted(default)
+    try:
+        return sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return sorted(default)
+
+
+def extra_matrix() -> "list[tuple[str, str, int]]":
+    """(breaker, family, lanes) shapes for the secp256k1 ladder and BLS
+    G1 kernels.  Breaker names match the ones ``crypto/batch.py`` routes
+    these device paths through, so a dead device is skipped and a compile
+    failure demotes through the same machinery."""
+    shapes = []
+    for b in _env_sizes(
+        "COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", DEFAULT_SECP_BUCKETS
+    ):
+        shapes.append(("secp_device", "secp-ladder", b))
+    for b in _env_sizes(
+        "COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", DEFAULT_BLS_BUCKETS
+    ):
+        shapes.append(("bls_g1", "bls-g1", b))
+    return shapes
+
+
+def _warm_extra(family: str, lanes: int) -> "dict[str, dict]":
+    """Resolve one secp/BLS shape's executables (no dispatch).  The seam
+    tests monkeypatch — exactly like ``ov.bucket_executable`` for the
+    ed25519 matrix.  Returns {exec-cache tag: info}."""
+    if family == "secp-ladder":
+        from cometbft_tpu.ops import secp_verify
+
+        return {
+            secp_verify.ladder_tag(lanes): secp_verify.warm_ladder(lanes)
+        }
+    from cometbft_tpu.ops import bls_g1
+
+    return bls_g1.warm_kernels(lanes)
 
 
 def warm_matrix() -> "list[tuple[str, int]]":
@@ -99,14 +152,27 @@ def run() -> dict:
     (``hit`` / ``miss``+compiled / ``memo`` / ``error:*`` / ``skipped:
     breaker-open``).  Never raises."""
     from cometbft_tpu.crypto import backend_health
-    from cometbft_tpu.ops import verify as ov
-    from cometbft_tpu.ops import warm_stats
+    from cometbft_tpu.libs import tracing
 
     t0 = time.perf_counter()
     reg = backend_health.registry()
     statuses: dict = {}
-    warmed = failures = 0
     dead: set = set()
+    # the with-block makes the root span exception-safe: a raise anywhere
+    # in the walk must not leak it onto the thread-local stack (every
+    # later span on this thread would mis-parent under it)
+    with tracing.span("warmboot.run"):
+        return _run_matrices(reg, statuses, dead, t0)
+
+
+def _run_matrices(reg, statuses: dict, dead: set, t0: float) -> dict:
+    """The matrix walk half of ``run()``, executed inside the root span."""
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.libs import tracing
+    from cometbft_tpu.ops import verify as ov
+    from cometbft_tpu.ops import warm_stats
+
+    warmed = failures = 0
     for backend, bucket in warm_matrix():
         key = f"{backend}-{bucket}"
         if backend in dead:
@@ -116,7 +182,16 @@ def run() -> dict:
             statuses[key] = "skipped:breaker-open"
             continue
         try:
-            _, info = ov.bucket_executable(backend, bucket)
+            # warm progress is span-visible: one span per shape, child of
+            # the pass's root span (docs/observability.md)
+            with tracing.span(
+                "warmboot.shape", family="ed25519", tier=backend,
+                lanes=bucket,
+            ) as shape_sp:
+                _, info = ov.bucket_executable(backend, bucket)
+                shape_sp.set(
+                    exec_cache=str(info.get("exec_cache", "compiled"))
+                )
             # a miss/stale probe that then compiled reports "compiled" —
             # the per-shape statuses are what bench --warmboot asserts on
             status = (
@@ -154,6 +229,47 @@ def run() -> dict:
                 "breaker, continuing with the next tier",
                 key,
                 e,
+            )
+    # secp256k1 ladder + BLS G1 kernels (ROADMAP item 4 follow-up: they
+    # used to compile on first use).  Same contract as the ed25519 loop:
+    # OPEN breakers are skipped, a compile failure records a breaker
+    # failure for that device family and moves on — boot never wedges.
+    for breaker, family, lanes in extra_matrix():
+        key = f"{family}-{lanes}"
+        if breaker in dead:
+            statuses[key] = "skipped:tier-demoted"
+            continue
+        if reg.breaker(breaker).state == backend_health.OPEN:
+            statuses[key] = "skipped:breaker-open"
+            continue
+        try:
+            with tracing.span(
+                "warmboot.shape", family=family, tier=breaker, lanes=lanes
+            ) as shape_sp:
+                infos = _warm_extra(family, lanes)
+                shape_sp.set(tags=len(infos))
+            for tag, info in infos.items():
+                status = (
+                    "compiled"
+                    if "compile_s" in info
+                    else str(info.get("exec_cache", "?"))
+                )
+                statuses[tag] = status
+                if not status.startswith(("unsupported", "no-roundtrip")):
+                    warmed += 1
+        except Exception as e:  # noqa: BLE001 — a compile failure demotes
+            # the device family via its breaker; boot itself never wedges
+            failures += 1
+            dead.add(breaker)
+            statuses.setdefault(key, f"error:{type(e).__name__}")
+            reg.breaker(breaker).record_failure(e)
+            reg.record_demotion(breaker)
+            logger.warning(
+                "warm-boot: compiling %s failed (%r); %s demoted via "
+                "breaker, continuing",
+                key,
+                e,
+                breaker,
             )
     # shapes the collapsed matrix no longer pays, per warmed tier
     tiers = {b for b, _ in warm_matrix()} or {"xla"}

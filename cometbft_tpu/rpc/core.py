@@ -685,6 +685,34 @@ class Environment:
             out.append(self.block(h))
         return {"blocks": out, "total_count": str(len(heights))}
 
+    # -- observability (docs/observability.md) -----------------------------
+
+    def debug_verify_trace(self, spans: int = 256) -> dict:
+        """One JSON document snapshotting the verify pipeline: flight-
+        recorder ring tail + per-stage latency summary + health (breaker
+        states, signature-cache hit rates, scheduler queue, warm-boot
+        progress).  Served as ``/debug/verify_trace`` (GET) and the
+        ``debug_verify_trace`` JSON-RPC method; the ``cometbft-tpu
+        trace`` CLI renders it.  Every read is jax-free by design — this
+        endpoint must work exactly when the node is sickest."""
+        from cometbft_tpu.libs import tracing
+
+        doc = tracing.trace_document(
+            max_spans=max(0, min(int(spans), 4096))
+        )
+        node = self.node
+        ctx: dict = {}
+        try:
+            ctx["latest_block_height"] = str(node.block_store.height())
+        except Exception:  # noqa: BLE001 — health must render regardless
+            pass
+        try:
+            ctx["consensus_height"] = str(node.consensus.rs.height)
+        except Exception:  # noqa: BLE001
+            pass
+        doc["node"] = ctx
+        return doc
+
     def broadcast_evidence(self, evidence) -> dict:
         """Reference: rpc/core/evidence.go BroadcastEvidence.  ``evidence``
         is the proto-encoded evidence (base64/hex/quoted per _bytes_arg)."""
@@ -736,6 +764,10 @@ ROUTES = {
     "tx_search": "tx_search",
     "block_search": "block_search",
     "broadcast_evidence": "broadcast_evidence",
+    # verify-pipeline flight recorder (docs/observability.md); the slash
+    # alias serves the conventional GET /debug/verify_trace path
+    "debug_verify_trace": "debug_verify_trace",
+    "debug/verify_trace": "debug_verify_trace",
 }
 
 # Served only when config rpc.unsafe is true (reference AddUnsafeRoutes,
@@ -755,6 +787,7 @@ _INT_PARAMS = {
     "per_page",
     "limit",
     "chunk",
+    "spans",
 }
 _BOOL_PARAMS = {"prove", "persistent", "unconditional", "private"}
 

@@ -129,6 +129,11 @@ class ScenarioResult:
     evidence: dict = field(default_factory=dict)
     # validator-set rotations the invariant checker authenticated
     rotations: int = 0
+    # flight-recorder capture (docs/observability.md): span/anomaly
+    # counts, per-stage latency summary over the ring, and the anomaly
+    # dump files (name + sha256 — hashed BEFORE the run root is deleted,
+    # so determinism tests byte-compare dumps across same-seed runs)
+    spans: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -174,6 +179,17 @@ class ScenarioResult:
             row["evidence"] = dict(self.evidence)
         if self.rotations:
             row["rotations"] = self.rotations
+        if self.spans:
+            row["spans"] = {
+                "recorded": self.spans.get("recorded", 0),
+                "anomalies": self.spans.get("anomalies", {}),
+                "dumps": [d["file"] for d in self.spans.get("dumps", ())],
+                # p99 per stage only — the full summary stays on the result
+                "p99_ms": {
+                    stage: s["p99_ms"]
+                    for stage, s in self.spans.get("stages", {}).items()
+                },
+            }
         return row
 
 
@@ -1289,11 +1305,29 @@ def run_scenario(
     sched_stats: dict = {}
     ingest_counters: dict = {}
     evidence_counters: dict = {}
+    spans_capture: dict = {}
     # per-run evidence counters: the process-wide stats must not bleed one
     # run's flood into the next run's ScenarioResult
     from cometbft_tpu.evidence import stats as _evstats
 
     _evstats.reset()
+    # flight recorder on the virtual clock: reset per run (span ids and
+    # therefore anomaly-dump bytes become a pure function of the seed),
+    # dumps land under the run root unless the caller pinned a dir
+    from cometbft_tpu.libs import tracing as _tracing
+
+    _tracer = _tracing.get_tracer()
+    _saved_trace_dir = os.environ.get("COMETBFT_TPU_TRACE_DIR")
+    _trace_dir = Path(root) / "flight"
+    os.environ["COMETBFT_TPU_TRACE_DIR"] = str(_trace_dir)
+    _tracer.reset()
+    _tracer.set_clock(cluster.clock.now)
+    # the dispatch ordinal in verify.dispatch spans comes from the
+    # process-wide dispatch counter — zero it so dump bytes are a pure
+    # function of the seed (tests only ever use dispatch-count DELTAS)
+    from cometbft_tpu.ops import dispatch_stats as _dstats
+
+    _dstats.reset()
     try:
         if scenario.setup is not None:
             scenario.setup(cluster)
@@ -1339,7 +1373,38 @@ def run_scenario(
         esnap = evstats.snapshot()
         if esnap["added"] or esnap["dedup"] or esnap["rejected"]:
             evidence_counters = esnap
+        # flight-recorder capture — dumps hashed NOW, before the run root
+        # (and the dump files under it) are deleted below
+        tsnap = _tracer.snapshot()
+        dumps = []
+        for name in tsnap["dumps"]:
+            try:
+                blob = (_trace_dir / name).read_bytes()
+            except OSError:
+                continue
+            import hashlib as _hashlib
+
+            dumps.append(
+                {
+                    "file": name,
+                    "bytes": len(blob),
+                    "sha256": _hashlib.sha256(blob).hexdigest(),
+                }
+            )
+        spans_capture = {
+            "recorded": tsnap["spans_recorded"],
+            "dropped": tsnap["spans_dropped"],
+            "anomalies": tsnap["anomalies"],
+            "stages": _tracer.stage_summary(),
+            "dumps": dumps,
+        }
     finally:
+        _tracer.set_clock(None)
+        _tracer.reset()
+        if _saved_trace_dir is None:
+            os.environ.pop("COMETBFT_TPU_TRACE_DIR", None)
+        else:
+            os.environ["COMETBFT_TPU_TRACE_DIR"] = _saved_trace_dir
         if scenario.teardown is not None:
             scenario.teardown(cluster)
         cluster.stop()
@@ -1363,4 +1428,5 @@ def run_scenario(
         ingest=ingest_counters,
         evidence=evidence_counters,
         rotations=cluster.checker.rotations_seen,
+        spans=spans_capture,
     )

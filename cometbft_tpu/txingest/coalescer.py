@@ -33,6 +33,7 @@ import time
 from collections import OrderedDict, deque
 from typing import Callable, Optional
 
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.txingest import stats
 
 logger = logging.getLogger("cometbft_tpu.txingest")
@@ -204,7 +205,9 @@ class IngestCoalescer:
         # queue full (or closing): shed to the per-tx synchronous path —
         # shedding costs the batching win, never a tx verdict
         stats.record_shed_sync()
-        res = self.mempool.check_tx(tx, sender=sender)
+        tracing.record_anomaly("ingest_shed", queue_cap=self.queue_cap)
+        with tracing.span("txingest.shed_sync"):
+            res = self.mempool.check_tx(tx, sender=sender)
         self._note_verified_nonce(pn, res)
         return res
 
@@ -266,7 +269,10 @@ class IngestCoalescer:
         keys = [it[2] for it in items]
         stats.record_flush(len(items), self.batch_max)
         try:
-            results = self.mempool.check_tx_batch(txs, senders, keys=keys)
+            with tracing.span(
+                "txingest.flush", txs=len(items), cap=self.batch_max
+            ):
+                results = self.mempool.check_tx_batch(txs, senders, keys=keys)
         except Exception:  # noqa: BLE001 — the flusher must survive
             logger.exception(
                 "batched admission failed; re-admitting %d txs per-tx",

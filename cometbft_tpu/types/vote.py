@@ -86,13 +86,19 @@ class Vote:
         apply/blocksync time (the CommitSig reconstructs byte-identical
         sign bytes from the same timestamp)."""
         from cometbft_tpu import verifysched
+        from cometbft_tpu.libs import tracing
 
-        return verifysched.verify_cached(
-            pub_key,
-            self.sign_bytes(chain_id),
-            self.signature,
-            priority=verifysched.PRIO_CONSENSUS,
-        )
+        with tracing.span(
+            "consensus.vote", h=self.height, r=self.round_, t=self.type_
+        ) as sp:
+            ok = verifysched.verify_cached(
+                pub_key,
+                self.sign_bytes(chain_id),
+                self.signature,
+                priority=verifysched.PRIO_CONSENSUS,
+            )
+            sp.set(ok=bool(ok))
+        return ok
 
     def copy(self) -> "Vote":
         return replace(self)

@@ -56,6 +56,7 @@ from concurrent.futures import Future
 from typing import Optional, Sequence
 
 from cometbft_tpu.crypto import sigcache
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.verifysched import stats
 
 logger = logging.getLogger("cometbft_tpu.verifysched")
@@ -151,7 +152,11 @@ def priority_class(priority: int):
 
 
 class _Item:
-    __slots__ = ("pub", "msg", "sig", "prio", "future", "t0")
+    # t0 = submit time, t_drain = when the dispatcher drained it out of
+    # the queue: submit->drain is QUEUE WAIT, drain->verdict is DEVICE
+    # time — recorded as separate histograms so queue pressure and device
+    # slowness are distinguishable regressions (docs/observability.md)
+    __slots__ = ("pub", "msg", "sig", "prio", "future", "t0", "t_drain")
 
     def __init__(self, pub, msg, sig, prio, future, t0):
         self.pub = pub
@@ -160,6 +165,7 @@ class _Item:
         self.prio = prio
         self.future = future
         self.t0 = t0
+        self.t_drain = t0
 
 
 class VerifyScheduler:
@@ -201,6 +207,7 @@ class VerifyScheduler:
         self._stopped = False
         self._paused = False
         self._full_target: Optional[int] = None
+        self._last_flush_t: Optional[float] = None  # flush-interval histo
 
     # -- submission -------------------------------------------------------
 
@@ -226,37 +233,50 @@ class VerifyScheduler:
                 stats.record_submit_hit(prio)
                 fut.set_result(bool(hit))
                 return fut
-        with self._cond:
-            if self._stopped:
-                raise RuntimeError("verify scheduler is stopped")
-            if prio != PRIO_CONSENSUS and self._count >= self.queue_cap:
-                stats.record_shed(prio)
-                raise QueueFullError(
-                    f"verify queue at capacity ({self.queue_cap}); "
-                    f"shedding class {stats.CLASS_NAMES[prio]}"
-                )
-            self._queues[prio].append(
-                _Item(pub, msg, sig, prio, fut, time.perf_counter())
-            )
-            self._count += 1
-            stats.record_submit(prio)
-            if self._thread is None or not self._thread.is_alive():
-                # lazily started — and RESTARTED if it ever died (an
-                # exception escaping even the _execute fallback, e.g.
-                # MemoryError): without this, every queued future would
-                # hang forever and take consensus with it.  The new
-                # thread drains whatever the dead one left queued.
-                if self._thread is not None:
-                    logger.error(
-                        "verify dispatcher thread died; restarting "
-                        "(%d items pending)",
-                        self._count,
+        try:
+            with self._cond:
+                if self._stopped:
+                    raise RuntimeError("verify scheduler is stopped")
+                if prio != PRIO_CONSENSUS and self._count >= self.queue_cap:
+                    stats.record_shed(prio)
+                    raise QueueFullError(
+                        f"verify queue at capacity ({self.queue_cap}); "
+                        f"shedding class {stats.CLASS_NAMES[prio]}"
                     )
-                self._thread = threading.Thread(
-                    target=self._run, name="verify-sched", daemon=True
+                self._queues[prio].append(
+                    _Item(pub, msg, sig, prio, fut, time.perf_counter())
                 )
-                self._thread.start()
-            self._cond.notify_all()
+                self._count += 1
+                stats.record_submit(prio)
+                if self._thread is None or not self._thread.is_alive():
+                    # lazily started — and RESTARTED if it ever died (an
+                    # exception escaping even the _execute fallback, e.g.
+                    # MemoryError): without this, every queued future would
+                    # hang forever and take consensus with it.  The new
+                    # thread drains whatever the dead one left queued.
+                    if self._thread is not None:
+                        logger.error(
+                            "verify dispatcher thread died; restarting "
+                            "(%d items pending)",
+                            self._count,
+                        )
+                    self._thread = threading.Thread(
+                        target=self._run, name="verify-sched", daemon=True
+                    )
+                    self._thread.start()
+                self._cond.notify_all()
+        except QueueFullError:
+            # flight-recorder anomaly (the FIRST shed dumps the ring;
+            # later sheds are counted), recorded AFTER the cond is
+            # released: the dump's file IO must never block other
+            # submitters — least of all shed-exempt consensus votes —
+            # behind the scheduler lock
+            tracing.record_anomaly(
+                "queue_shed",
+                cls=stats.CLASS_NAMES[prio],
+                queue_cap=self.queue_cap,
+            )
+            raise
         return fut
 
     def submit_many(
@@ -344,9 +364,12 @@ class VerifyScheduler:
 
     def _drain(self) -> "list[_Item]":
         out: "list[_Item]" = []
+        now = time.perf_counter()
         for q in self._queues:  # consensus first
             while q and len(out) < MAX_DRAIN:
-                out.append(q.popleft())
+                it = q.popleft()
+                it.t_drain = now
+                out.append(it)
         self._count -= len(out)
         return out
 
@@ -441,73 +464,93 @@ class VerifyScheduler:
         msgs = [it.msg for it in items]
         sigs = [it.sig for it in items]
 
-        # structural filter (garbage never occupies a device lane) +
-        # in-flight dedup: concurrent gossip of the same vote collapses
-        # into one lane, both futures share the verdict
-        bits: "list[Optional[bool]]" = [None] * n
-        uniq: "OrderedDict[bytes, list[int]]" = OrderedDict()
-        for i in range(n):
-            if len(pubs[i]) != 32 or len(sigs[i]) != 64:
-                bits[i] = False
-                continue
-            k = sigcache._key(pubs[i], msgs[i], sigs[i])
-            uniq.setdefault(k, []).append(i)
-        firsts = [ixs[0] for ixs in uniq.values()]
-        stats.record_dedup(sum(len(ixs) - 1 for ixs in uniq.values()))
+        # flush span (closed BEFORE futures resolve, like the stats below,
+        # so a deterministic sim's ring order cannot race its waiters)
+        with tracing.span("sched.flush", reason=reason, items=n) as fsp:
+            # structural filter (garbage never occupies a device lane) +
+            # in-flight dedup: concurrent gossip of the same vote collapses
+            # into one lane, both futures share the verdict
+            bits: "list[Optional[bool]]" = [None] * n
+            uniq: "OrderedDict[bytes, list[int]]" = OrderedDict()
+            for i in range(n):
+                if len(pubs[i]) != 32 or len(sigs[i]) != 64:
+                    bits[i] = False
+                    continue
+                k = sigcache._key(pubs[i], msgs[i], sigs[i])
+                uniq.setdefault(k, []).append(i)
+            firsts = [ixs[0] for ixs in uniq.values()]
+            stats.record_dedup(sum(len(ixs) - 1 for ixs in uniq.values()))
 
-        lanes = 0
-        if firsts:
-            from cometbft_tpu.ops import verify as ov
+            lanes = 0
+            if firsts:
+                from cometbft_tpu.ops import verify as ov
 
-            # one segment per priority class present: verify_segments fuses
-            # them into ONE dispatch (recording cross-class fusion in
-            # ops/dispatch_stats) and splits the bits back per class
-            by_class: "list[list[int]]" = [[] for _ in range(N_CLASSES)]
-            for i in firsts:
-                by_class[items[i].prio].append(i)
-            ordered = [i for cls in by_class for i in cls]
-            work = [
-                (
-                    [pubs[i] for i in cls],
-                    [msgs[i] for i in cls],
-                    [sigs[i] for i in cls],
+                # one segment per priority class present: verify_segments
+                # fuses them into ONE dispatch (recording cross-class
+                # fusion in ops/dispatch_stats), splits bits back per class
+                by_class: "list[list[int]]" = [[] for _ in range(N_CLASSES)]
+                for i in firsts:
+                    by_class[items[i].prio].append(i)
+                ordered = [i for cls in by_class for i in cls]
+                work = [
+                    (
+                        [pubs[i] for i in cls],
+                        [msgs[i] for i in cls],
+                        [sigs[i] for i in cls],
+                    )
+                    for cls in by_class
+                    if cls
+                ]
+                lanes = ov.bucket_size(len(ordered), ov._min_bucket())
+                results = ov.verify_segments(work)
+                # verdicts keyed by FIRST index of each dedup group (the
+                # hash was already paid once in the dedup loop above)
+                verdict_by_first = dict(
+                    zip(ordered, (bool(b) for seg in results for b in seg))
                 )
-                for cls in by_class
-                if cls
-            ]
-            lanes = ov.bucket_size(len(ordered), ov._min_bucket())
-            results = ov.verify_segments(work)
-            # verdicts keyed by FIRST index of each dedup group (the hash
-            # was already paid once in the dedup loop above)
-            verdict_by_first = dict(
-                zip(ordered, (bool(b) for seg in results for b in seg))
-            )
-            # resolve every member of each dedup group + cache writeback.
-            # Inlined rather than sigcache.writeback: that would re-hash
-            # every entry, and the dedup loop already holds the keys —
-            # on the single dispatcher thread a third SHA-256 per item
-            # gates every waiter's latency.  Supervised verdicts are
-            # always definitive, so caching unconditionally is safe.
-            cache = sigcache.get_cache()
-            cache_on = cache.enabled()
-            for k, ixs in uniq.items():
-                v = verdict_by_first[ixs[0]]
-                for i in ixs:
-                    bits[i] = v
-                if cache_on:
-                    cache._put(k, v)
+                # resolve every member of each dedup group + cache
+                # writeback.  Inlined rather than sigcache.writeback: that
+                # would re-hash every entry, and the dedup loop already
+                # holds the keys — on the single dispatcher thread a third
+                # SHA-256 per item gates every waiter's latency.
+                # Supervised verdicts are always definitive, so caching
+                # unconditionally is safe.
+                cache = sigcache.get_cache()
+                cache_on = cache.enabled()
+                for k, ixs in uniq.items():
+                    v = verdict_by_first[ixs[0]]
+                    for i in ixs:
+                        bits[i] = v
+                    if cache_on:
+                        cache._put(k, v)
+            fsp.set(misses=len(firsts), lanes=lanes)
 
         # record BEFORE resolving: set_result unblocks waiters, and a
         # caller reading stats right after its verdict (the sim's
         # end-of-run capture asserts queue_depth == 0) must not race the
         # dispatcher's bookkeeping; ``recorded`` keeps the _execute
         # fallback from double-counting if a resolve below raises
-        stats.record_flush(reason, items=n, misses=len(firsts), lanes=lanes)
+        t_flush = items[0].t_drain
+        interval = (
+            None
+            if self._last_flush_t is None
+            else t_flush - self._last_flush_t
+        )
+        self._last_flush_t = t_flush
+        stats.record_flush(
+            reason, items=n, misses=len(firsts), lanes=lanes,
+            interval_s=interval,
+        )
         recorded[0] = True
         now = time.perf_counter()
         for i, it in enumerate(items):
             it.future.set_result(bool(bits[i]))
-            stats.record_verdict(it.prio, now - it.t0)
+            stats.record_verdict(
+                it.prio,
+                now - it.t0,
+                queue_wait_s=it.t_drain - it.t0,
+                device_s=now - it.t_drain,
+            )
 
 
 # -- process-wide instance ----------------------------------------------------
@@ -554,6 +597,23 @@ def verify_now(pub_key, msg: bytes, sig: bytes) -> bool:
     return sigcache.verify_with_cache(pub_key, msg, sig)
 
 
+def _shed_fallback_verify(pub_key, msg: bytes, sig: bytes, prio: int) -> bool:
+    """The synchronous verify a SHED caller runs: emits a span and a
+    submit->verdict histogram sample so shed work stays in the latency
+    record instead of vanishing from it (docs/observability.md)."""
+    t0 = time.perf_counter()
+    with tracing.span(
+        "sched.shed_fallback", cls=stats.CLASS_NAMES[_clamp_prio(prio)]
+    ):
+        ok = verify_now(pub_key, msg, sig)
+    stats.record_shed_fallback(prio, time.perf_counter() - t0)
+    return ok
+
+
+def _clamp_prio(priority: int) -> int:
+    return min(max(int(priority), 0), N_CLASSES - 1)
+
+
 def verify_cached(pub_key, msg: bytes, sig: bytes, priority=None) -> bool:
     """THE drop-in for ``sigcache.verify_with_cache`` on scheduler-wired
     call sites (gossip-time ``Vote.verify``, proposal and vote-extension
@@ -567,10 +627,10 @@ def verify_cached(pub_key, msg: bytes, sig: bytes, priority=None) -> bool:
                 return bool(
                     get_scheduler().submit(pub, msg, sig, prio).result()
                 )
-            except QueueFullError:
-                pass  # shed (recorded): verify synchronously below
-            except RuntimeError:
-                pass  # scheduler torn down under us (reset race): sync path
+            except (QueueFullError, RuntimeError):
+                # shed, or scheduler torn down under us (reset race):
+                # synchronous fallback — spanned + histogram-sampled
+                return _shed_fallback_verify(pub_key, msg, sig, prio)
     return verify_now(pub_key, msg, sig)
 
 
@@ -583,6 +643,7 @@ def verify_many_cached(
     prio = current_priority() if priority is None else priority
     out: "list[Optional[bool]]" = [None] * len(msgs)
     futs: "list[Optional[Future]]" = [None] * len(msgs)
+    shed_ix: set = set()
     if scheduler_active():
         sched = get_scheduler()
         for i, (pk, m, s) in enumerate(zip(pub_keys, msgs, sigs)):
@@ -593,9 +654,12 @@ def verify_many_cached(
                 futs[i] = sched.submit(pub, m, s, prio)
             except (QueueFullError, RuntimeError):
                 futs[i] = None  # shed or torn down: sync fallback below
+                shed_ix.add(i)
     for i, (pk, m, s) in enumerate(zip(pub_keys, msgs, sigs)):
         if futs[i] is not None:
             out[i] = bool(futs[i].result())
+        elif i in shed_ix:
+            out[i] = _shed_fallback_verify(pk, m, s, prio)
         else:
             out[i] = verify_now(pk, m, s)
     return out
@@ -621,11 +685,22 @@ def verify_segment_sync(
     if shed:
         from cometbft_tpu.ops import verify as ov
 
-        got = ov.verify_batch(
-            [pubs[i] for i in shed],
-            [msgs[i] for i in shed],
-            [sigs[i] for i in shed],
-        )
+        t0 = time.perf_counter()
+        with tracing.span(
+            "sched.shed_fallback",
+            cls=stats.CLASS_NAMES[_clamp_prio(prio)],
+            items=len(shed),
+        ):
+            got = ov.verify_batch(
+                [pubs[i] for i in shed],
+                [msgs[i] for i in shed],
+                [sigs[i] for i in shed],
+            )
+        dt = time.perf_counter() - t0
+        for _ in shed:
+            # every shed item experienced the whole direct dispatch —
+            # that IS its submit->verdict latency, kept in the record
+            stats.record_shed_fallback(prio, dt)
         direct = {i: bool(b) for i, b in zip(shed, got)}
     return [
         direct[i] if f is None else bool(f.result())

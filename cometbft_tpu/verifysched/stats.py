@@ -26,11 +26,26 @@ Counters (all guarded by one lock):
     single lane at flush time (concurrent gossip of the same vote)
   * ``verdicts[class]`` / ``latency_seconds[class]`` — resolved futures and
     cumulative submit->verdict latency, per priority class
+
+Hot-path latency HISTOGRAMS (docs/observability.md) — real distributions,
+not just cumulative sums, rendered on /metrics as histogram series:
+
+  * ``latency_hist[class]``    — submit->verdict, per priority class
+    (includes ``record_shed_fallback`` samples: a shed caller's sync
+    verify stays in the latency record instead of vanishing from it)
+  * ``queue_wait_hist[class]`` — submit->drain wait, per class (recorded
+    SEPARATELY from device time: queue pressure and device slowness are
+    different regressions)
+  * ``device_hist[class]``     — drain->verdict (flush execution) share
+  * ``flush_interval_hist``    — time between consecutive flush starts
+  * ``shed_fallback[class]``   — sync fallbacks that recorded a sample
 """
 
 from __future__ import annotations
 
 import threading
+
+from cometbft_tpu.libs.histo import Histo
 
 CLASS_NAMES = ("consensus", "evidence_light", "bulk")
 FLUSH_REASONS = ("deadline", "full", "shutdown")
@@ -51,6 +66,12 @@ def _zero() -> dict:
         "dedup_hits": 0,
         "verdicts": {c: 0 for c in CLASS_NAMES},
         "latency_seconds": {c: 0.0 for c in CLASS_NAMES},
+        "queue_wait_seconds": {c: 0.0 for c in CLASS_NAMES},
+        "shed_fallback": {c: 0 for c in CLASS_NAMES},
+        "latency_hist": {c: Histo() for c in CLASS_NAMES},
+        "queue_wait_hist": {c: Histo() for c in CLASS_NAMES},
+        "device_hist": {c: Histo() for c in CLASS_NAMES},
+        "flush_interval_hist": Histo(),
     }
 
 
@@ -77,13 +98,21 @@ def record_shed(priority: int) -> None:
         _STATS["shed"][_cls(priority)] += 1
 
 
-def record_flush(reason: str, items: int, misses: int, lanes: int) -> None:
+def record_flush(
+    reason: str,
+    items: int,
+    misses: int,
+    lanes: int,
+    interval_s: "float | None" = None,
+) -> None:
     with _LOCK:
         _STATS["flushes"][reason] = _STATS["flushes"].get(reason, 0) + 1
         _STATS["flush_items"] += int(items)
         _STATS["flush_misses"] += int(misses)
         _STATS["flush_lanes"] += int(lanes)
         _STATS["queue_depth"] = max(0, _STATS["queue_depth"] - int(items))
+        if interval_s is not None:
+            _STATS["flush_interval_hist"].observe(float(interval_s))
 
 
 def record_dedup(n: int) -> None:
@@ -92,11 +121,38 @@ def record_dedup(n: int) -> None:
             _STATS["dedup_hits"] += int(n)
 
 
-def record_verdict(priority: int, latency_s: float) -> None:
+def record_verdict(
+    priority: int,
+    latency_s: float,
+    queue_wait_s: "float | None" = None,
+    device_s: "float | None" = None,
+) -> None:
+    """One resolved future.  ``queue_wait_s`` (submit->drain) and
+    ``device_s`` (drain->verdict) are recorded as SEPARATE distributions
+    when the dispatcher knows them — a latency regression then names the
+    guilty half instead of hiding in the conflated total."""
     with _LOCK:
         c = _cls(priority)
         _STATS["verdicts"][c] += 1
         _STATS["latency_seconds"][c] += float(latency_s)
+        _STATS["latency_hist"][c].observe(float(latency_s))
+        if queue_wait_s is not None:
+            _STATS["queue_wait_seconds"][c] += float(queue_wait_s)
+            _STATS["queue_wait_hist"][c].observe(float(queue_wait_s))
+        if device_s is not None:
+            _STATS["device_hist"][c].observe(float(device_s))
+
+
+def record_shed_fallback(priority: int, latency_s: float) -> None:
+    """A shed (or scheduler-inactive-mid-teardown) caller finished its
+    synchronous fallback verify: the sample lands in the SAME
+    submit->verdict latency record as scheduled work, so shedding can
+    never silently improve the histogram it degraded."""
+    with _LOCK:
+        c = _cls(priority)
+        _STATS["shed_fallback"][c] += 1
+        _STATS["latency_seconds"][c] += float(latency_s)
+        _STATS["latency_hist"][c].observe(float(latency_s))
 
 
 def queue_depth() -> int:
@@ -104,19 +160,26 @@ def queue_depth() -> int:
         return _STATS["queue_depth"]
 
 
+def _copy(v):
+    if isinstance(v, Histo):
+        return v.to_dict()
+    if isinstance(v, dict):
+        return {k: _copy(x) for k, x in v.items()}
+    return v
+
+
 def snapshot() -> dict:
-    """Deep-enough copy for metrics/tests; adds derived aggregates."""
+    """Deep-enough copy for metrics/tests; adds derived aggregates.
+    Histograms render as their ``Histo.to_dict`` wire shape."""
     with _LOCK:
-        out = {
-            k: (dict(v) if isinstance(v, dict) else v)
-            for k, v in _STATS.items()
-        }
+        out = {k: _copy(v) for k, v in _STATS.items()}
     out["flush_occupancy"] = (
         out["flush_misses"] / out["flush_lanes"] if out["flush_lanes"] else 0.0
     )
     out["verdicts_total"] = sum(out["verdicts"].values())
     out["latency_seconds_total"] = sum(out["latency_seconds"].values())
     out["shed_total"] = sum(out["shed"].values())
+    out["shed_fallback_total"] = sum(out["shed_fallback"].values())
     return out
 
 

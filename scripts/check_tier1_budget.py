@@ -42,6 +42,20 @@ _EXEC_RE = re.compile(
     r"hits=(\d+)\s+load_s=([0-9.]+)"
 )
 
+# "tier1-trace: spans=1234 dropped=0 anomalies=2 dumps=1 overhead_s=0.04"
+# — printed by tests/conftest.py's terminal summary (libs/tracing); the
+# overhead share is gated: the flight recorder is default-on, so a
+# regression in its record path would silently tax every verify
+_TRACE_RE = re.compile(
+    r"tier1-trace:\s+spans=(\d+)\s+dropped=(\d+)\s+anomalies=(\d+)\s+"
+    r"dumps=(\d+)\s+overhead_s=([0-9.]+)"
+)
+
+# the recorder's measured in-process overhead must stay a rounding error
+# of tier-1 wall time (the sched-bench gate in bench.py --obs is the
+# precise one; this is the coarse suite-wide backstop)
+TRACE_OVERHEAD_MAX_SHARE = 0.05
+
 # tests whose dominant cost is a device-kernel compile (the population the
 # warm-boot PR targets); used for the durations-table compile share
 _COMPILE_HEAVY = (
@@ -128,6 +142,30 @@ def compile_share(text: str, wall: float) -> "list[str]":
     return out
 
 
+def trace_share(text: str, wall: float) -> "tuple[list[str], bool]":
+    """(report lines, ok) for the flight-recorder summary line.  A log
+    with no line simply reports nothing (older logs, subprocess-only
+    runs); a parsed overhead share past ``TRACE_OVERHEAD_MAX_SHARE``
+    fails the gate."""
+    m = None
+    for m in _TRACE_RE.finditer(text):
+        pass  # keep the LAST summary line, like the wall-time parse
+    if m is None or wall <= 0:
+        return [], True
+    spans, dropped, anomalies, dumps = (int(m.group(i)) for i in range(1, 5))
+    overhead_s = float(m.group(5))
+    share = overhead_s / wall
+    ok = share <= TRACE_OVERHEAD_MAX_SHARE
+    lines = [
+        f"tier1-budget: flight recorder {spans} spans ({dropped} dropped), "
+        f"{anomalies} anomalies, {dumps} dumps; recorder overhead "
+        f"{overhead_s:.3f}s = {100.0 * share:.2f}% of wall"
+        + ("" if ok else
+           f" -> FAIL (> {100.0 * TRACE_OVERHEAD_MAX_SHARE:g}%)")
+    ]
+    return lines, ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -165,8 +203,12 @@ def main() -> int:
     share = sim_share(text, wall) if text else None
     if share:
         print(share)
+    trace_ok = True
     if text:
         for line in compile_share(text, wall):
+            print(line)
+        trace_lines, trace_ok = trace_share(text, wall)
+        for line in trace_lines:
             print(line)
 
     margin = args.budget - wall
@@ -176,6 +218,8 @@ def main() -> int:
             f"{args.budget:g}s by {-margin:.1f}s (hard timeout is 870s — "
             "slow-mark the new heaviest tests or shrink fixtures)"
         )
+        return 1
+    if not trace_ok:
         return 1
     print(
         f"tier1-budget: ok wall={wall:.1f}s budget={args.budget:g}s "

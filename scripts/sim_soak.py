@@ -81,6 +81,25 @@ def _row_extra(row: dict) -> str:
         )
     if row.get("rotations"):
         extra += " rot=%d" % row["rotations"]
+    spans = row.get("spans") or {}
+    if spans:
+        # flight-recorder shape of the run: span volume, anomaly kinds and
+        # the worst p99 stage latencies (virtual ms) — a latency
+        # regression shows up as a diffable column, not a rerun
+        extra += " spans=%d" % spans.get("recorded", 0)
+        anomalies = spans.get("anomalies") or {}
+        if anomalies:
+            extra += " anom=%s" % ",".join(
+                "%s:%d" % kv for kv in sorted(anomalies.items())
+            )
+        if spans.get("dumps"):
+            extra += " dumps=%d" % len(spans["dumps"])
+        p99 = spans.get("p99_ms") or {}
+        worst = sorted(p99.items(), key=lambda kv: -kv[1])[:3]
+        if worst:
+            extra += " p99ms=" + ",".join(
+                "%s:%.1f" % (stage.split(".")[-1], ms) for stage, ms in worst
+            )
     return extra
 
 

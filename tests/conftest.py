@@ -88,13 +88,20 @@ def pytest_collection_modifyitems(config, items):
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):
-    """One parseable exec-cache line in the tier-1 log —
-    scripts/check_tier1_budget.py reads the compile-time share from it.
-    Per-process counters: spawned node subprocesses keep their own, so
-    this is a lower bound on suite-wide compile time."""
+    """Parseable summary lines in the tier-1 log —
+    scripts/check_tier1_budget.py reads the compile-time share from the
+    exec-cache line and the flight-recorder overhead share from the
+    trace line.  Per-process counters: spawned node subprocesses keep
+    their own, so both are lower bounds on suite-wide totals."""
     try:
         from cometbft_tpu.ops import warm_stats
 
         terminalreporter.write_line(warm_stats.summary_line())
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from cometbft_tpu.libs import tracing
+
+        terminalreporter.write_line(tracing.summary_line())
     except Exception:  # noqa: BLE001
         pass

@@ -296,9 +296,21 @@ class TestBackendFaultScenarios:
         # scenario teardown restored every piece of process-global state
         assert self._snapshot_globals() == before
 
-    def test_backend_wedge_watchdog_and_progress(self, tmp_path):
+    def test_backend_wedge_watchdog_and_progress(self, tmp_path, monkeypatch):
+        """Watchdog/breaker behavior under a wedge, PLUS the ISSUE 9
+        acceptance forensics on the SAME run (one scenario run, not two,
+        for the tier-1 budget): the run yields a JSONL flight-recorder
+        dump whose spans attribute the watchdog fire to a specific
+        (bucket, tier, dispatch).  Byte-identical same-seed replay is the
+        slow-lane test below."""
+        import json as _json
+
+        # the dump assertions REQUIRE the recorder: pin it on even if the
+        # ambient environment exported the kill switch
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")
         res = run_scenario(
-            "backend-wedge", 5, root=tmp_path, raise_on_violation=True
+            "backend-wedge", 5, root=tmp_path, raise_on_violation=True,
+            keep_cluster=True,
         )
         assert res.reached, f"heights {res.heights}"
         assert not res.violations
@@ -306,6 +318,42 @@ class TestBackendFaultScenarios:
         assert b["watchdog_fires"] >= 1, b
         assert b["demotions"] >= 1, b
         assert b["repromotions"] >= 1, b
+        # flight-recorder forensics: dump produced + attribution
+        # (keep_cluster preserves the run root, so the dump is readable)
+        dump_files = {d["file"] for d in res.spans["dumps"]}
+        assert any("watchdog_fire" in f for f in dump_files), res.spans
+        assert res.spans["anomalies"].get("watchdog_fire", 0) >= 1
+        wd = next(f for f in sorted(dump_files) if "watchdog_fire" in f)
+        lines = [_json.loads(l) for l in open(tmp_path / "flight" / wd)]
+        head = lines[0]
+        assert head["attrs"]["tier"] == "xla"
+        assert head["attrs"]["lanes"] >= 1  # the padding bucket
+        assert head["attrs"]["dispatch"] >= 1  # the dispatch ordinal
+        failed = [
+            s for s in lines[1:]
+            if s["stage"] == "verify.dispatch"
+            and s["attrs"].get("error") == "DispatchTimeoutError"
+        ]
+        assert failed
+        assert failed[-1]["attrs"]["dispatch"] == head["attrs"]["dispatch"]
+        res.cluster.stop()
+
+    @pytest.mark.slow
+    def test_backend_wedge_dump_byte_identical(self, tmp_path, monkeypatch):
+        """Same seed => byte-identical anomaly dumps (name, size, sha256):
+        span times ride the VirtualClock and the recorder + dispatch
+        ordinal reset per run, so the dump is a pure function of the
+        seed.  (Slow lane: doubles a whole scenario run — the PR-1/PR-3
+        determinism-double-run precedent; the single-run dump and its
+        attribution stay tier-1 above.)"""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")
+        a = run_scenario("backend-wedge", 5, root=tmp_path / "a")
+        b = run_scenario("backend-wedge", 5, root=tmp_path / "b")
+        assert a.spans["dumps"], a.spans
+        assert a.spans["dumps"] == b.spans["dumps"], (
+            a.spans["dumps"],
+            b.spans["dumps"],
+        )
 
     def test_backend_flap_breaker_cycles(self, tmp_path):
         res = run_scenario(
@@ -320,12 +368,13 @@ class TestBackendFaultScenarios:
         assert b["breaker_opens"] >= 2, b
         assert b["repromotions"] >= 1, b
 
-    def test_gossip_burst_sheds_only_bulk(self, tmp_path):
+    def test_gossip_burst_sheds_only_bulk(self, tmp_path, monkeypatch):
         """Verify-scheduler overload (ISSUE 5): scripted bulk bursts blow
         past the scenario's 48-slot queue.  Admission control must shed
         only bulk-class items — consensus votes are exempt by design — and
         the cluster must agree and progress as if the overload never
         happened (a shed only costs the batching win, never a verdict)."""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")  # dump asserts below
         before = self._snapshot_globals()
         res = run_scenario(
             "gossip-burst", 3, root=tmp_path, raise_on_violation=True
@@ -340,6 +389,16 @@ class TestBackendFaultScenarios:
         assert sum(s["flushes"].values()) > 0, s
         # all admitted futures resolved; nothing left hanging in the queue
         assert s["queue_depth"] == 0, s
+        # queue-wait and device time recorded as SEPARATE distributions
+        assert s["queue_wait_hist"]["consensus"]["count"] > 0, s
+        assert s["device_hist"]["consensus"]["count"] > 0, s
+        # the first shed dumped the flight recorder (anomaly forensics)
+        assert res.spans["anomalies"].get("queue_shed", 0) > 0, res.spans
+        assert any(
+            "queue_shed" in d["file"] for d in res.spans["dumps"]
+        ), res.spans
+        assert res.spans["recorded"] > 0
+        assert "sched.flush" in res.spans["stages"], res.spans["stages"]
         assert self._snapshot_globals() == before
 
     def test_tx_flood_batched_admission(self, tmp_path):
@@ -397,18 +456,29 @@ class TestBackendFaultScenarios:
         assert a.ingest == b.ingest
 
     @pytest.mark.slow
-    def test_gossip_burst_deterministic(self, tmp_path):
+    def test_gossip_burst_deterministic(self, tmp_path, monkeypatch):
         """Same seed => byte-identical traces with the scheduler in the
         verify path: coalescing grouping is wall-time-dependent, but
         verdicts (and therefore every traced event, including the shed
         counts logged by the burst actions) are not.  (Slow lane: doubles
         a whole scenario run — the PR-1/PR-3 precedent for determinism
         double-runs; single-run scheduler behavior stays tier-1 above.)"""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")  # dump asserts below
         a = run_scenario("gossip-burst", 17, root=tmp_path / "a")
         b = run_scenario("gossip-burst", 17, root=tmp_path / "b")
         assert a.trace == b.trace
         assert a.heights == b.heights
         assert a.sched["shed"] == b.sched["shed"]
+        # the queue-shed anomaly dump replays byte-identically too: the
+        # flight recorder rides the VirtualClock and resets per run, so
+        # dump bytes are a pure function of the seed even with the
+        # dispatcher thread in the loop (flush spans land while the
+        # single-threaded sim blocks on its verdicts)
+        assert a.spans["dumps"] == b.spans["dumps"], (
+            a.spans["dumps"],
+            b.spans["dumps"],
+        )
+        assert any("queue_shed" in d["file"] for d in a.spans["dumps"])
 
     @pytest.mark.slow
     def test_backend_brownout_deterministic(self, tmp_path):

@@ -16,7 +16,13 @@ from cometbft_tpu.ops import warm_stats, warmboot
 
 
 @pytest.fixture(autouse=True)
-def _clean():
+def _clean(monkeypatch):
+    # pin the secp/BLS extra matrices EMPTY for the legacy ed25519-matrix
+    # tests: their run() calls would otherwise really compile the ladder
+    # and G1 kernels (~30s/shape on this host).  TestExtraMatrix re-enables
+    # them against a monkeypatched warm seam.
+    monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "")
+    monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
     backend_health.reset()
     warmboot.reset()
     yield
@@ -202,3 +208,125 @@ class TestStart:
         assert t2 is not None and t2 is not t1
         t2.join(5)
         assert len(runs) == 2
+
+
+class TestExtraMatrix:
+    """The secp ladder / BLS G1 families riding the warm pass (ROADMAP
+    item 4 follow-up).  The warm seam (``warmboot._warm_extra``) is
+    monkeypatched: these pin the matrix walk, breaker gating and status
+    accounting, not the kernel compiles themselves."""
+
+    def test_default_families_and_env_bounds(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", raising=False)
+        monkeypatch.delenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", raising=False)
+        shapes = warmboot.extra_matrix()
+        assert [
+            s for br, f, s in shapes if f == "secp-ladder"
+        ] == sorted(warmboot.DEFAULT_SECP_BUCKETS)
+        assert [
+            s for br, f, s in shapes if f == "bls-g1"
+        ] == sorted(warmboot.DEFAULT_BLS_BUCKETS)
+        assert {br for br, f, _ in shapes if f == "secp-ladder"} == {
+            "secp_device"
+        }
+        assert {br for br, f, _ in shapes if f == "bls-g1"} == {"bls_g1"}
+        # env override bounds each family; empty skips it entirely
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "4,2")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
+        shapes = warmboot.extra_matrix()
+        assert [s for _, f, s in shapes if f == "secp-ladder"] == [2, 4]
+        assert not [s for _, f, s in shapes if f == "bls-g1"]
+
+    def _fake_exec(self, calls):
+        def fake(backend, bucket, donated=None):
+            calls.append((backend, bucket))
+            return (lambda **kw: None), {"exec_cache": "hit"}
+
+        return fake
+
+    def test_run_walks_extra_families(self, monkeypatch):
+        warmed = []
+
+        def fake_extra(family, lanes):
+            warmed.append((family, lanes))
+            return {f"{family}-{lanes}": {"exec_cache": "hit"}}
+
+        monkeypatch.setattr(ov, "bucket_executable", self._fake_exec([]))
+        monkeypatch.setattr(warmboot, "_warm_extra", fake_extra)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "1,2")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "4")
+        report = warmboot.run()
+        assert ("secp-ladder", 1) in warmed
+        assert ("secp-ladder", 2) in warmed
+        assert ("bls-g1", 4) in warmed
+        assert report["statuses"]["secp-ladder-1"] == "hit"
+        assert report["statuses"]["bls-g1-4"] == "hit"
+        # extra-family hits count toward the warmed total
+        assert report["warmed"] >= 4
+
+    def test_extra_compile_failure_demotes_family_breaker(self, monkeypatch):
+        def fake_extra(family, lanes):
+            raise RuntimeError("lowering exploded")
+
+        monkeypatch.setattr(ov, "bucket_executable", self._fake_exec([]))
+        monkeypatch.setattr(warmboot, "_warm_extra", fake_extra)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "1,2")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "4")
+        report = warmboot.run()  # must not raise
+        # first secp shape failed -> family dead, second shape skipped
+        assert report["statuses"]["secp-ladder-1"].startswith("error:")
+        assert report["statuses"]["secp-ladder-2"] == "skipped:tier-demoted"
+        # bls has its own breaker: also failed independently
+        assert report["statuses"]["bls-g1-4"].startswith("error:")
+        assert report["failures"] == 2
+        reg = backend_health.registry()
+        assert reg.breaker("secp_device").stats()["failures_total"] == 1
+        assert reg.breaker("bls_g1").stats()["failures_total"] == 1
+
+    def test_extra_open_breaker_skipped(self, monkeypatch):
+        called = []
+
+        def fake_extra(family, lanes):
+            called.append((family, lanes))
+            return {}
+
+        monkeypatch.setattr(ov, "bucket_executable", self._fake_exec([]))
+        monkeypatch.setattr(warmboot, "_warm_extra", fake_extra)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "2")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
+        br = backend_health.registry().breaker("secp_device")
+        for _ in range(br.threshold):
+            br.record_failure(RuntimeError("dead"))
+        assert br.state == backend_health.OPEN
+        report = warmboot.run()
+        assert not called
+        assert report["statuses"]["secp-ladder-2"] == "skipped:breaker-open"
+
+    def test_warm_progress_is_span_visible(self, monkeypatch):
+        from cometbft_tpu.libs import tracing
+
+        tracing.get_tracer().reset()
+        monkeypatch.setattr(ov, "bucket_executable", self._fake_exec([]))
+        monkeypatch.setattr(
+            warmboot,
+            "_warm_extra",
+            lambda f, s: {f"{f}-{s}": {"exec_cache": "hit"}},
+        )
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "2")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "4")
+        warmboot.run()
+        stages = tracing.get_tracer().stage_summary()
+        assert stages["warmboot.run"]["count"] == 1
+        # ed25519 shapes (per tier) + secp + bls, all children of the run
+        assert stages["warmboot.shape"]["count"] >= 3
+        spans = tracing.get_tracer().tail(64)
+        shape = [s for s in spans if s["stage"] == "warmboot.shape"]
+        run = [s for s in spans if s["stage"] == "warmboot.run"]
+        assert run and all(s.get("parent") == run[0]["span"] for s in shape)
+        fams = {s["attrs"]["family"] for s in shape}
+        assert {"ed25519", "secp-ladder", "bls-g1"} <= fams
+        tracing.get_tracer().reset()
