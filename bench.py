@@ -960,14 +960,20 @@ def run_obs(emit, n=128, reps=3) -> dict:
     knobs = (
         "COMETBFT_TPU_TRACE",
         "COMETBFT_TPU_TRACE_DIR",
+        "COMETBFT_TPU_TRACE_XNODE",
         "COMETBFT_TPU_SIGCACHE",
         "COMETBFT_TPU_VERIFY_SCHED",
     )
     saved = {k: os.environ.get(k) for k in knobs}
     # every rep must do real verify work (no cache hits), with no dump IO
-    # or scheduler queueing inside the timed region
+    # or scheduler queueing inside the timed region.  Cross-node context
+    # propagation is pinned ON: the gates below re-baseline the recorder
+    # with the PR-11 span taxonomy (round/step spans, ctx encode on the
+    # gossip path) active, and must hold unchanged (disabled <=1%,
+    # enabled <=5%).
     os.environ["COMETBFT_TPU_SIGCACHE"] = "0"
     os.environ["COMETBFT_TPU_VERIFY_SCHED"] = "0"
+    os.environ["COMETBFT_TPU_TRACE_XNODE"] = "1"
     os.environ.pop("COMETBFT_TPU_TRACE_DIR", None)
     supervisor.set_device_runner(oracle)
     tracer = tracing.get_tracer()

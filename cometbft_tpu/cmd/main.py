@@ -304,7 +304,9 @@ def cmd_trace(args) -> int:
     if args.local:
         from cometbft_tpu.libs import tracing
 
-        doc = tracing.trace_document(max_spans=args.spans)
+        doc = tracing.trace_document(
+            max_spans=args.spans, rounds=args.rounds
+        )
     else:
         import urllib.request
 
@@ -313,7 +315,10 @@ def cmd_trace(args) -> int:
             addr = "http://" + addr[len("tcp://"):]
         if not addr.startswith(("http://", "https://")):
             addr = "http://" + addr
-        url = f"{addr.rstrip('/')}/debug_verify_trace?spans={args.spans}"
+        url = (
+            f"{addr.rstrip('/')}/debug_verify_trace"
+            f"?spans={args.spans}&rounds={args.rounds}"
+        )
         try:
             with urllib.request.urlopen(url, timeout=10) as resp:
                 reply = json.loads(resp.read())
@@ -388,6 +393,44 @@ def cmd_trace(args) -> int:
                     row["p50_ms"],
                     row["p99_ms"],
                     row["max_ms"],
+                )
+            )
+    rounds = doc.get("rounds") or {}
+    if rounds.get("rounds_seen"):
+        print(
+            "rounds: seen=%s commits linked=%s unlinked=%s standalone=%s"
+            % (
+                rounds.get("rounds_seen"),
+                rounds.get("commits_linked"),
+                rounds.get("commits_unlinked"),
+                rounds.get("commits_standalone"),
+            )
+        )
+        for step, s in sorted((rounds.get("steps") or {}).items()):
+            print(
+                "  step %-22s n=%-5d p50=%8.3fms p99=%8.3fms"
+                % (step, s.get("count", 0), s.get("p50_ms", 0.0),
+                   s.get("p99_ms", 0.0))
+            )
+        for k, q in sorted((rounds.get("quorum") or {}).items()):
+            if q.get("count"):
+                print(
+                    "  quorum %-20s n=%-5d p50=%8.3fms p99=%8.3fms"
+                    % (k, q["count"], q.get("p50_ms", 0.0),
+                       q.get("p99_ms", 0.0))
+                )
+        for g in rounds.get("rounds") or []:
+            committed = sum(
+                1 for nd in g["nodes"] if nd.get("committed")
+            )
+            print(
+                "  round h=%-5s r=%-3s origin=%-4s trace=%-6s nodes=%d "
+                "committed=%d verify_commits=%d"
+                % (
+                    g["h"], g["r"],
+                    "?" if g["origin"] is None else g["origin"],
+                    "?" if g["trace"] is None else g["trace"],
+                    len(g["nodes"]), committed, g["commits"],
                 )
             )
     return 0
@@ -783,6 +826,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "--spans", type=int, default=256,
         help="ring-tail spans to include (default 256)",
+    )
+    sp.add_argument(
+        "--rounds", type=int, default=8,
+        help="last-K merged consensus-round timelines to include "
+             "(default 8; 0 skips the section)",
     )
     sp.add_argument("--json", action="store_true", help="raw JSON document")
     sp.set_defaults(fn=cmd_trace)

@@ -104,10 +104,16 @@ class ProposalPOLMessage:
 
 @dataclass
 class MsgInfo:
-    """A message + where it came from ("" = internal)."""
+    """A message + where it came from ("" = internal).
+
+    ``trace_ctx`` is the OPTIONAL flight-recorder trace context the gossip
+    envelope carried (an encoded ``libs.tracing.TraceContext`` token, or
+    None): pure observability metadata — it never reaches the WAL or the
+    wire codec, so a node with tracing off is byte-compatible."""
 
     msg: object
     peer_id: str = ""
+    trace_ctx: Optional[object] = None
 
 
 # -- serialization ----------------------------------------------------------

@@ -46,6 +46,7 @@ from cometbft_tpu.consensus.types import (
 )
 from cometbft_tpu.consensus.wal import WAL
 from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.libs import tracing
 from cometbft_tpu.libs.service import BaseService
 from cometbft_tpu.state.execution import BlockExecutor
 from cometbft_tpu.state.state import State
@@ -134,6 +135,20 @@ class ConsensusState(BaseService):
         # header in their block id); drained once the PartSet exists
         self._orphan_parts: list = []
 
+        # flight-recorder round anchor (docs/observability.md "Cross-node
+        # tracing"): one unfinished ``consensus.round`` span per (height,
+        # round), opened at round entry and recorded when the round ends.
+        # It is the ambient parent of every span the round produces (step
+        # timings, proposal/vote checks, the commit's verify pipeline) and
+        # the thing a received proposal's trace context re-parents, so a
+        # commit's verify spans on this node link to the proposal that
+        # originated on the proposer.  ``trace_origin`` names this node in
+        # propagated contexts (the sim sets it to the node index).
+        self.trace_origin = None
+        self._round_span = None
+        self._step_t0 = 0.0
+        self._step_prev: Optional[str] = None
+
         self.update_to_state(state)
 
     # ------------------------------------------------------------------
@@ -164,8 +179,10 @@ class ConsensusState(BaseService):
     # public API (enqueue only)
     # ------------------------------------------------------------------
 
-    def add_peer_message(self, msg: object, peer_id: str) -> None:
-        self._queue.put(("peer", MsgInfo(msg, peer_id)))
+    def add_peer_message(
+        self, msg: object, peer_id: str, trace_ctx=None
+    ) -> None:
+        self._queue.put(("peer", MsgInfo(msg, peer_id, trace_ctx)))
 
     def _add_internal_message(self, msg: object) -> None:
         self._queue.put(("internal", MsgInfo(msg, "")))
@@ -280,14 +297,136 @@ class ConsensusState(BaseService):
     def _handle_msg(self, mi: MsgInfo) -> None:
         with self._mtx:
             msg = mi.msg
-            if isinstance(msg, ProposalMessage):
-                self._set_proposal(msg.proposal)
-            elif isinstance(msg, BlockPartMessage):
-                added = self._add_proposal_block_part(msg)
-                if added:
-                    self._on_block_part_added(msg.height)
-            elif isinstance(msg, VoteMessage):
-                self._try_add_vote(msg.vote, mi.peer_id)
+            self._maybe_adopt_ctx(mi)
+            # every span the message produces (proposal/vote signature
+            # checks, block validation, the commit's verify pipeline)
+            # parents under this round's anchor and therefore inherits
+            # the round trace — cross-node once the anchor is adopted
+            with tracing.get_tracer().under(self._round_span):
+                if isinstance(msg, ProposalMessage):
+                    self._set_proposal(msg.proposal)
+                elif isinstance(msg, BlockPartMessage):
+                    added = self._add_proposal_block_part(msg)
+                    if added:
+                        self._on_block_part_added(msg.height)
+                elif isinstance(msg, VoteMessage):
+                    self._try_add_vote(msg.vote, mi.peer_id)
+
+    # ------------------------------------------------------------------
+    # flight-recorder round anchors (docs/observability.md)
+    # ------------------------------------------------------------------
+
+    def _maybe_adopt_ctx(self, mi: MsgInfo) -> None:
+        """Link this node's round anchor into the sender's trace: a
+        proposal (or a vote/part from a node that already linked) carries
+        the round trace rooted at the proposer's anchor.  First adoption
+        wins; the proposer's own anchor (the root) never adopts."""
+        if mi.trace_ctx is None:
+            return
+        sp = self._round_span
+        if sp is None or sp.parent_id is not None or sp.attrs.get("proposer"):
+            return
+        ctx = tracing.TraceContext.decode(mi.trace_ctx)
+        if ctx is None:
+            return
+        msg = mi.msg
+        if isinstance(msg, ProposalMessage):
+            h, r = msg.proposal.height, msg.proposal.round_
+        elif isinstance(msg, VoteMessage):
+            h, r = msg.vote.height, msg.vote.round_
+        elif isinstance(msg, BlockPartMessage):
+            h, r = msg.height, msg.round_
+        else:
+            return
+        if sp.attrs.get("h") == h and sp.attrs.get("r") == r:
+            tracing.get_tracer().adopt(sp, ctx)
+
+    def _open_round_span(self, height: int, round_: int) -> None:
+        tr = tracing.get_tracer()
+        attrs = {"h": height, "r": round_}
+        if self.trace_origin is not None:
+            attrs["node"] = self.trace_origin
+        self._round_span = tr.begin("consensus.round", **attrs)
+        self._step_t0 = tr.time()
+        self._step_prev = None
+
+    def _close_round_span(self, committed: bool) -> None:
+        sp = self._round_span
+        if sp is None:
+            return
+        self._round_span = None
+        tr = tracing.get_tracer()
+        if self._step_prev is not None:
+            self._record_step_span(sp, self._step_prev, tr.time())
+        self._step_prev = None
+        tr.finish(sp, committed=committed)
+
+    def _rotate_round_span(self, height: int, round_: int) -> None:
+        sp = self._round_span
+        if (
+            sp is not None
+            and sp.attrs.get("h") == height
+            and sp.attrs.get("r") == round_
+        ):
+            return  # same round re-entered (wait-for-txs loop)
+        self._close_round_span(committed=False)
+        self._open_round_span(height, round_)
+
+    def _record_step_span(self, sp, step_name: str, now: float) -> None:
+        attrs = {
+            "h": sp.attrs.get("h"),
+            "r": sp.attrs.get("r"),
+            "step": step_name,
+        }
+        if self.trace_origin is not None:
+            attrs["node"] = self.trace_origin
+        tracing.get_tracer().record_span(
+            "consensus.step", self._step_t0, now, parent=sp, **attrs
+        )
+        self._step_t0 = now
+
+    def _note_step_transition(self) -> None:
+        """Called on every (height, round, step) transition: records the
+        PREVIOUS step's duration as a ``consensus.step`` span under the
+        round anchor — retroactive, because a step's length is only known
+        once the next one begins."""
+        sp = self._round_span
+        if sp is None:
+            return
+        name = self.rs.step_name()
+        if name == self._step_prev:
+            return
+        now = tracing.get_tracer().time()
+        if self._step_prev is not None:
+            self._record_step_span(sp, self._step_prev, now)
+        else:
+            self._step_t0 = now
+        self._step_prev = name
+
+    def _note_quorum(self, key: str, round_: int) -> None:
+        """Stamp a quorum-arrival time (ms since round entry) onto the
+        round anchor the first time 2/3 power lands for ``round_`` —
+        time-to-2/3-prevotes / time-to-2/3-precommits."""
+        sp = self._round_span
+        if sp is None or key in sp.attrs or sp.attrs.get("r") != round_:
+            return
+        t = tracing.get_tracer().time() - sp.t_start
+        sp.set(**{key: round(t * 1e3, 6)})
+
+    def current_trace_ctx(self):
+        """The trace context outgoing gossip should carry, or None.  Only
+        a LINKED anchor propagates — the proposer's root, or an anchor
+        adopted into the proposal's trace — so every context on the wire
+        resolves to the originating proposal's trace id (a node that has
+        not seen the proposal yet gossips context-free)."""
+        sp = self._round_span
+        if sp is None or not tracing.xnode_enabled():
+            return None
+        if sp.parent_id is None and not sp.attrs.get("proposer"):
+            return None
+        return tracing.TraceContext(
+            sp.trace_id, sp.span_id, self.trace_origin
+        )
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         with self._mtx:
@@ -296,29 +435,38 @@ class ConsensusState(BaseService):
                 ti.round_ == rs.round_ and ti.step < rs.step
             ):
                 return  # stale
-            if ti.step == STEP_NEW_HEIGHT:
-                self._enter_new_round(ti.height, 0)
-            elif ti.step == STEP_NEW_ROUND:
-                self._enter_propose(ti.height, 0)
-            elif ti.step == STEP_PROPOSE:
-                if self.event_bus:
-                    self.event_bus.publish_timeout_propose(
-                        EventDataRoundState(rs.height, rs.round_, rs.step_name())
-                    )
-                self._enter_prevote(ti.height, ti.round_)
-            elif ti.step == STEP_PREVOTE_WAIT:
-                if self.event_bus:
-                    self.event_bus.publish_timeout_wait(
-                        EventDataRoundState(rs.height, rs.round_, rs.step_name())
-                    )
-                self._enter_precommit(ti.height, ti.round_)
-            elif ti.step == STEP_PRECOMMIT_WAIT:
-                if self.event_bus:
-                    self.event_bus.publish_timeout_wait(
-                        EventDataRoundState(rs.height, rs.round_, rs.step_name())
-                    )
-                self._enter_precommit(ti.height, ti.round_)
-                self._enter_new_round(ti.height, ti.round_ + 1)
+            with tracing.get_tracer().under(self._round_span):
+                self._dispatch_timeout(ti)
+
+    def _dispatch_timeout(self, ti: TimeoutInfo) -> None:
+        """Timeout-driven transitions under the round anchor, so verify
+        work a timeout triggers (prevote-time block validation, a
+        timeout-path finalize) links to the round trace exactly like
+        message-driven work."""
+        rs = self.rs
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            if self.event_bus:
+                self.event_bus.publish_timeout_propose(
+                    EventDataRoundState(rs.height, rs.round_, rs.step_name())
+                )
+            self._enter_prevote(ti.height, ti.round_)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            if self.event_bus:
+                self.event_bus.publish_timeout_wait(
+                    EventDataRoundState(rs.height, rs.round_, rs.step_name())
+                )
+            self._enter_precommit(ti.height, ti.round_)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            if self.event_bus:
+                self.event_bus.publish_timeout_wait(
+                    EventDataRoundState(rs.height, rs.round_, rs.step_name())
+                )
+            self._enter_precommit(ti.height, ti.round_)
+            self._enter_new_round(ti.height, ti.round_ + 1)
 
     def _handle_txs_available(self) -> None:
         with self._mtx:
@@ -341,6 +489,7 @@ class ConsensusState(BaseService):
         self._vote_listeners.append(fn)
 
     def _new_step(self) -> None:
+        self._note_step_transition()
         if self.event_bus:
             self.event_bus.publish_new_round_step(
                 EventDataRoundState(
@@ -371,6 +520,7 @@ class ConsensusState(BaseService):
         ):
             return
         self.logger.debug("enter new round", height=height, round=round_)
+        self._rotate_round_span(height, round_)
 
         validators = rs.validators
         if rs.round_ < round_:
@@ -495,6 +645,11 @@ class ConsensusState(BaseService):
             self.logger.error("failed to sign proposal", err=repr(e))
             return
 
+        # mark the round anchor as the trace ROOT before the broadcast:
+        # the outgoing proposal (and everything after) now carries this
+        # node's round-trace context for the cluster to adopt
+        if self._round_span is not None:
+            self._round_span.set(proposer=True)
         self._add_internal_message(ProposalMessage(proposal))
         for i in range(parts.header.total):
             self._add_internal_message(
@@ -766,31 +921,35 @@ class ConsensusState(BaseService):
         block, parts = rs.proposal_block, rs.proposal_block_parts
         block_id = BlockID(hash=block.hash(), part_set_header=parts.header)
 
-        self.block_exec.validate_block(self.state, block)
+        # the commit's verify work (LastCommit re-verification inside
+        # validate/apply) parents under the round anchor, so its spans
+        # carry the originating proposal's trace id
+        with tracing.get_tracer().under(self._round_span):
+            self.block_exec.validate_block(self.state, block)
 
-        fail_point(10)
-        # save block + seen commit (DISK)
-        if self.block_store.height() < height:
-            precommits = rs.votes.precommits(rs.commit_round)
-            seen_commit = precommits.make_commit()
-            ext_commit = (
-                precommits.make_extended_commit()
-                if self._extensions_enabled(height)
-                else None
+            fail_point(10)
+            # save block + seen commit (DISK)
+            if self.block_store.height() < height:
+                precommits = rs.votes.precommits(rs.commit_round)
+                seen_commit = precommits.make_commit()
+                ext_commit = (
+                    precommits.make_extended_commit()
+                    if self._extensions_enabled(height)
+                    else None
+                )
+                self.block_store.save_block(
+                    block, parts, seen_commit, extended_commit=ext_commit
+                )
+
+            fail_point(11)
+            # WAL end-height marker (DISK fsync) — replay boundary
+            if self.wal is not None:
+                self.wal.write_end_height(height)
+            fail_point(12)
+
+            new_state = self.block_exec.apply_verified_block(
+                self.state, block_id, block
             )
-            self.block_store.save_block(
-                block, parts, seen_commit, extended_commit=ext_commit
-            )
-
-        fail_point(11)
-        # WAL end-height marker (DISK fsync) — replay boundary
-        if self.wal is not None:
-            self.wal.write_end_height(height)
-        fail_point(12)
-
-        new_state = self.block_exec.apply_verified_block(
-            self.state, block_id, block
-        )
 
         fail_point(13)
         self.logger.info(
@@ -799,6 +958,7 @@ class ConsensusState(BaseService):
             hash=lambda: block.hash(),
             n_txs=len(block.data.txs),
         )
+        self._close_round_span(committed=True)
         self.update_to_state(new_state)
         self._schedule_round0()
 
@@ -807,6 +967,10 @@ class ConsensusState(BaseService):
     # ------------------------------------------------------------------
 
     def update_to_state(self, state: State) -> None:
+        # a round anchor still open here means the height ended without
+        # this node finalizing (blocksync overtook it, statesync restart):
+        # record it un-committed rather than leak it
+        self._close_round_span(committed=False)
         rs = self.rs
         last_precommits: Optional[VoteSet] = None
         if rs.commit_round > -1 and rs.votes is not None:
@@ -872,7 +1036,6 @@ class ConsensusState(BaseService):
         # is verified once per process, and on accelerator-backed nodes the
         # check coalesces with in-flight vote verifications
         from cometbft_tpu import verifysched
-        from cometbft_tpu.libs import tracing
 
         with tracing.span(
             "consensus.proposal", h=proposal.height, r=proposal.round_
@@ -1076,8 +1239,6 @@ class ConsensusState(BaseService):
         # extension signatures when serving/validating extended commits.
         # Scheduled at consensus priority: the extension check rides the
         # same fused dispatch as the vote signature it arrived with.
-        from cometbft_tpu.libs import tracing
-
         with tracing.span(
             "consensus.vote_ext", h=vote.height, r=vote.round_
         ):
@@ -1110,6 +1271,7 @@ class ConsensusState(BaseService):
         prevotes = rs.votes.prevotes(vote.round_)
         block_id = prevotes.two_thirds_majority()
         if block_id is not None:
+            self._note_quorum("q_prevote_ms", vote.round_)
             # unlock if polka for something newer than our lock
             if (
                 rs.locked_block is not None
@@ -1155,6 +1317,7 @@ class ConsensusState(BaseService):
         precommits = rs.votes.precommits(vote.round_)
         block_id = precommits.two_thirds_majority()
         if block_id is not None:
+            self._note_quorum("q_precommit_ms", vote.round_)
             self._enter_new_round(rs.height, vote.round_)
             self._enter_precommit(rs.height, vote.round_)
             if not block_id.is_zero():

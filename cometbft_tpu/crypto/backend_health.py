@@ -53,6 +53,11 @@ HALF_OPEN = "half-open"
 
 _STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
+# the ed25519 degradation-chain tiers (ops/supervisor.device_chain plus the
+# aggregate "tpu" backend name) share the "breaker_open" anomaly kind; any
+# breaker outside this set gets its own per-name kind in record_failure
+_ED25519_CHAIN_TIERS = ("pallas", "xla", "tpu")
+
 
 class BackendError(RuntimeError):
     """Base class for infrastructure failures the supervisor attributes to
@@ -194,11 +199,21 @@ class CircuitBreaker:
         if opened:
             # flight-recorder anomaly (docs/observability.md), recorded
             # OUTSIDE the breaker lock: the first open since reset dumps
-            # the span ring for postmortem
+            # the span ring for postmortem.  The ed25519 degradation-chain
+            # tiers share one taxonomy kind (one chain, one story); every
+            # OTHER breaker — secp_device, bls_g1, and any single-tier
+            # backend added later — automatically gets its own
+            # ``breaker_open_<name>`` kind, so its first open still dumps
+            # even after an ed25519-tier open latched the shared kind.
             from cometbft_tpu.libs import tracing
 
+            kind = (
+                "breaker_open"
+                if self.name in _ED25519_CHAIN_TIERS
+                else f"breaker_open_{self.name}"
+            )
             tracing.record_anomaly(
-                "breaker_open",
+                kind,
                 backend=self.name,
                 opens=self._opens,
                 error=self._last_error,

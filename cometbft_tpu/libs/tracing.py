@@ -48,6 +48,22 @@ Kill switch: ``COMETBFT_TPU_TRACE=0`` compiles spans down to no-ops (a
 shared null context manager; one env read per span site) — bench.py
 ``--obs`` pins the disabled overhead at ≤1% of the sched bench.
 
+Cross-node correlation (docs/observability.md "Cross-node tracing"): a
+``TraceContext`` is the compact (trace_id, span_id, origin-node) triple a
+gossip envelope carries so consensus-round spans on different nodes form
+ONE causal tree per (height, round) — the proposer's ``consensus.round``
+span is the root, every receiver's round span adopts its trace id, and a
+commit's verify spans on node B link back to the proposal that originated
+on node A through nothing but the shared trace id.  Event-driven stages
+that outlive any ``with`` block (a consensus round spans many receive-loop
+events) use the explicit ``begin``/``finish`` API; ``under`` temporarily
+makes such an unfinished span the ambient parent so the verify pipeline
+underneath it inherits the round's trace.  ``COMETBFT_TPU_TRACE_XNODE=0``
+turns off context propagation (spans still record, per-node only).
+``rounds_report`` merges the ring into per-(height, round) timelines —
+tolerant of orphan parents (a crashed proposer's root span never records;
+the group still renders with ``origin=None``) and of ring-bound drops.
+
 Deliberately free of jax imports, like ``ops/dispatch_stats``: the
 ``/metrics`` scrape, the ``/debug/verify_trace`` RPC and the
 ``cometbft-tpu trace`` CLI all read this module, and none of them may be
@@ -68,10 +84,16 @@ logger = logging.getLogger("cometbft_tpu.tracing")
 
 DEFAULT_RING = 4096
 DEFAULT_DUMP_SPANS = 256
-# anomaly kinds with a dump trigger (docs/observability.md)
+# anomaly kinds with a dump trigger (docs/observability.md).  Breaker
+# opens are per-taxonomy-kind: the ed25519 device tiers share
+# "breaker_open", while the single-tier secp256k1/BLS breakers get their
+# own kinds — each kind's FIRST open dumps, so an ed25519 brownout can no
+# longer eat the one dump a simultaneous secp_device failure deserved.
 ANOMALY_KINDS = (
     "watchdog_fire",
     "breaker_open",
+    "breaker_open_secp_device",
+    "breaker_open_bls_g1",
     "queue_shed",
     "ingest_shed",
     "quarantine",
@@ -88,6 +110,66 @@ def enabled() -> bool:
 
 def trace_dir() -> Optional[str]:
     return os.environ.get("COMETBFT_TPU_TRACE_DIR") or None
+
+
+def xnode_enabled() -> bool:
+    """Whether gossip envelopes carry trace contexts
+    (``COMETBFT_TPU_TRACE_XNODE=0`` disables propagation while keeping
+    per-node spans).  Implies the recorder itself being on."""
+    return (
+        enabled()
+        and os.environ.get("COMETBFT_TPU_TRACE_XNODE", "1") != "0"
+    )
+
+
+class TraceContext:
+    """The compact trace context a gossip envelope propagates: the
+    sender's round-trace id, the span to parent under, and the origin
+    node.  Encodes to a short ASCII token so any transport (sim fabric
+    today, a p2p envelope field tomorrow) can carry it opaquely."""
+
+    __slots__ = ("trace_id", "span_id", "origin")
+
+    def __init__(self, trace_id: int, span_id: int, origin=None):
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id)
+        self.origin = origin
+
+    def encode(self) -> str:
+        o = "" if self.origin is None else str(int(self.origin))
+        return f"{self.trace_id:x}.{self.span_id:x}.{o}"
+
+    @classmethod
+    def decode(cls, token) -> "Optional[TraceContext]":
+        """Tolerant decode: garbage, truncation or a foreign format yield
+        None (a malformed context must never fail message handling)."""
+        if isinstance(token, TraceContext):
+            return token
+        if not isinstance(token, str):
+            return None
+        parts = token.split(".")
+        if len(parts) != 3:
+            return None
+        try:
+            trace_id = int(parts[0], 16)
+            span_id = int(parts[1], 16)
+            origin = int(parts[2]) if parts[2] else None
+        except ValueError:
+            return None
+        if trace_id <= 0 or span_id <= 0:
+            return None
+        return cls(trace_id, span_id, origin)
+
+    def __repr__(self) -> str:  # debugging/trace logs
+        return f"TraceContext({self.encode()!r})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, TraceContext)
+            and self.trace_id == other.trace_id
+            and self.span_id == other.span_id
+            and self.origin == other.origin
+        )
 
 
 class Span:
@@ -184,6 +266,34 @@ class _SpanCtx:
         return False
 
 
+class _UnderCtx:
+    """Pushes an unfinished explicit span as the ambient parent for the
+    duration of a block; pops by identity so nested/rotated anchors can
+    never unbalance the stack."""
+
+    __slots__ = ("tracer", "sp")
+
+    def __init__(self, tracer: "Tracer", sp: Span):
+        self.tracer = tracer
+        self.sp = sp
+
+    def __enter__(self) -> Span:
+        self.tracer._stack().append(self.sp)
+        return self.sp
+
+    def __exit__(self, *exc) -> bool:
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self.sp:
+            stack.pop()
+        elif self.sp in stack:
+            stack.remove(self.sp)
+        return False
+
+    def set(self, **attrs):  # parity with _NullSpan for disabled callers
+        self.sp.set(**attrs)
+        return self
+
+
 class Tracer:
     """Bounded flight recorder; all methods are thread-safe.
 
@@ -257,6 +367,115 @@ class Tracer:
     def current_trace(self) -> Optional[int]:
         stack = getattr(self._tls, "stack", None)
         return stack[-1].trace_id if stack else None
+
+    def time(self) -> float:
+        """The tracer's clock (virtual in sim).  Event-driven callers use
+        it for retroactive ``record_span`` timestamps so span times always
+        share one time base with the rest of the ring."""
+        return self._clock()
+
+    # -- explicit span API (event-driven stages) ---------------------------
+    #
+    # A consensus round outlives any single receive-loop event, so no
+    # ``with`` block can bracket it: ``begin`` allocates an UNFINISHED span
+    # (id + start time), the state machine mutates/adopts it across events,
+    # and ``finish`` stamps the end time and lands it in the ring — still
+    # on completion, still from the owning thread.
+
+    def begin(
+        self,
+        stage: str,
+        parent: Optional[Span] = None,
+        ctx: Optional[TraceContext] = None,
+        **attrs,
+    ) -> Optional[Span]:
+        """Allocate an unfinished span.  ``parent`` (a local span) or
+        ``ctx`` (a remote trace context) seed the trace; with neither the
+        span is a trace root.  Returns None when tracing is disabled —
+        every other explicit-API call accepts None as a no-op."""
+        if not enabled():
+            return None
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif ctx is not None:
+            trace_id, parent_id = ctx.trace_id, ctx.span_id
+            if ctx.origin is not None:
+                attrs.setdefault("xnode", ctx.origin)
+        else:
+            trace_id, parent_id = sid, None
+        return Span(trace_id, sid, parent_id, stage, self._clock(), attrs)
+
+    def finish(self, sp: Optional[Span], **attrs) -> None:
+        """Stamp the end time and record an explicit span.  Idempotent on
+        an already-finished span; None is a no-op."""
+        if sp is None or sp.t_end is not None:
+            return
+        if attrs:
+            sp.attrs.update(attrs)
+        sp.t_end = self._clock()
+        self._append(sp)
+
+    def adopt(self, sp: Optional[Span], ctx: Optional[TraceContext]) -> bool:
+        """Re-parent a still-rootless unfinished span under a remote
+        context — how a receiver's ``consensus.round`` span joins the
+        originating proposal's trace.  No-op (False) once the span has a
+        parent or has finished: first adoption wins."""
+        if (
+            sp is None
+            or ctx is None
+            or sp.parent_id is not None
+            or sp.t_end is not None
+        ):
+            return False
+        sp.trace_id = ctx.trace_id
+        sp.parent_id = ctx.span_id
+        if ctx.origin is not None:
+            sp.attrs.setdefault("xnode", ctx.origin)
+        return True
+
+    def record_span(
+        self,
+        stage: str,
+        t_start: float,
+        t_end: float,
+        parent: Optional[Span] = None,
+        **attrs,
+    ) -> Optional[Span]:
+        """Manufacture a COMPLETED span with explicit timestamps (taken
+        from ``time()``) — retroactive step timing: the consensus state
+        machine only knows a step's duration once the next step begins."""
+        if not enabled():
+            return None
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = sid, None
+        sp = Span(trace_id, sid, parent_id, stage, t_start, attrs)
+        sp.t_end = t_end
+        self._append(sp)
+        return sp
+
+    def under(self, sp: Optional[Span]):
+        """Context manager making an UNFINISHED explicit span the ambient
+        parent, so ``span()`` sites underneath (verify.commit, dispatches)
+        inherit its trace — the linkage that lets a commit's verify spans
+        resolve to the originating proposal.  ``under(None)`` is a shared
+        no-op."""
+        if sp is None or not enabled():
+            return _NULL_SPAN
+        return _UnderCtx(self, sp)
+
+    def ctx_for(self, sp: Optional[Span], origin=None) -> Optional[TraceContext]:
+        """A propagatable context pointing at an explicit span."""
+        if sp is None:
+            return None
+        return TraceContext(sp.trace_id, sp.span_id, origin)
 
     def _append(self, sp: Span) -> None:
         t0 = time.perf_counter()
@@ -400,6 +619,151 @@ class Tracer:
             }
         return out
 
+    def rounds_report(self, last_k: Optional[int] = None) -> dict:
+        """Merged cross-node round timelines over the spans currently in
+        the ring: one group per (height, round), carrying every node's
+        ``consensus.round`` span (duration, committed flag, quorum-arrival
+        times, per-step durations) plus the count of ``verify.commit``
+        spans that link to the group's trace — the proof that a commit's
+        verification attributes to the proposal that originated it.
+
+        Orphan tolerance by construction: a group whose root span never
+        recorded (crashed proposer, ring-bound drop) still renders, with
+        ``origin=None``; a step or commit span whose parent fell off the
+        ring still aggregates by its own (h, r)/trace attrs.  The report
+        is a pure function of the span stream, so two same-seed sim runs
+        serialize byte-identically (sort_keys JSON)."""
+        with self._lock:
+            ring = list(self._ring)
+        groups: dict = {}  # (h, r) -> group dict
+        step_agg: dict = {}  # step name -> [durations]
+        quorum_agg: dict = {"prevote_ms": [], "precommit_ms": []}
+        commit_traces: dict = {}  # trace_id -> verify.commit span count
+        commits_total = 0
+        commits_standalone = 0
+
+        def group(h, r) -> dict:
+            g = groups.get((h, r))
+            if g is None:
+                g = groups[(h, r)] = {
+                    "h": h,
+                    "r": r,
+                    "trace": None,
+                    "origin": None,
+                    "nodes": {},
+                    "traces": set(),
+                }
+            return g
+
+        def node_entry(g, node) -> dict:
+            e = g["nodes"].get(node)
+            if e is None:
+                e = g["nodes"][node] = {"node": node, "steps": {}}
+            return e
+
+        for sp in ring:
+            if sp.t_end is None:
+                continue
+            a = sp.attrs
+            if sp.stage == "consensus.round":
+                g = group(a.get("h"), a.get("r"))
+                g["traces"].add(sp.trace_id)
+                e = node_entry(g, a.get("node"))
+                e["dur_ms"] = round(sp.duration * 1e3, 6)
+                e["committed"] = bool(a.get("committed"))
+                e["adopted"] = sp.parent_id is not None
+                for k, agg in (
+                    ("q_prevote_ms", "prevote_ms"),
+                    ("q_precommit_ms", "precommit_ms"),
+                ):
+                    if k in a:
+                        e[k] = a[k]
+                        quorum_agg[agg].append(a[k])
+                if sp.parent_id is None and a.get("proposer"):
+                    # the trace root: the PROPOSER's round span.  A merely
+                    # rootless span (a node that never adopted — partition,
+                    # or propagation off) must not claim the round's origin
+                    g["trace"] = sp.trace_id
+                    g["origin"] = a.get("node")
+            elif sp.stage == "consensus.step":
+                g = group(a.get("h"), a.get("r"))
+                e = node_entry(g, a.get("node"))
+                dur = round(sp.duration * 1e3, 6)
+                e["steps"][a.get("step", "?")] = dur
+                step_agg.setdefault(a.get("step", "?"), []).append(
+                    sp.duration
+                )
+            elif sp.stage == "verify.commit":
+                if sp.parent_id is None:
+                    # a standalone verification (light client, statesync
+                    # trust check, the sim's invariant checker): its own
+                    # trace root by construction — not a linkage failure
+                    commits_standalone += 1
+                    continue
+                commits_total += 1
+                commit_traces[sp.trace_id] = (
+                    commit_traces.get(sp.trace_id, 0) + 1
+                )
+
+        all_traces: set = set()
+        rounds = []
+        for (h, r) in sorted(
+            groups, key=lambda k: (k[0] is None, k[0] or 0, k[1] or 0)
+        ):
+            g = groups[(h, r)]
+            all_traces |= g["traces"]
+            n_commits = sum(
+                commit_traces.get(t, 0) for t in sorted(g["traces"])
+            )
+            if g["trace"] is None and len(g["traces"]) == 1:
+                # orphan root: the trace id is still known from the
+                # adopted members, only the proposer's span is missing
+                g["trace"] = next(iter(g["traces"]))
+            rounds.append(
+                {
+                    "h": h,
+                    "r": r,
+                    "trace": g["trace"],
+                    "origin": g["origin"],
+                    "commits": n_commits,
+                    "nodes": [
+                        g["nodes"][k]
+                        for k in sorted(
+                            g["nodes"], key=lambda n: (n is None, n)
+                        )
+                    ],
+                }
+            )
+
+        def pctls(durs: list) -> dict:
+            if not durs:
+                return {"count": 0}
+            durs = sorted(durs)
+            n = len(durs)
+            return {
+                "count": n,
+                "p50_ms": round(durs[n // 2] * 1e3, 6),
+                "p99_ms": round(
+                    durs[min(n - 1, (n * 99) // 100)] * 1e3, 6
+                ),
+                "max_ms": round(durs[-1] * 1e3, 6),
+            }
+
+        linked = sum(commit_traces.get(t, 0) for t in all_traces)
+        return {
+            "rounds_seen": len(rounds),
+            "rounds": rounds[-last_k:] if last_k else rounds,
+            "steps": {k: pctls(v) for k, v in sorted(step_agg.items())},
+            "quorum": {
+                # already in ms — scale back for the shared helper
+                k: pctls([x / 1e3 for x in v])
+                for k, v in sorted(quorum_agg.items())
+            },
+            "commits_linked": linked,
+            "commits_unlinked": commits_total - linked,
+            "commits_standalone": commits_standalone,
+        }
+
     # -- lifecycle ---------------------------------------------------------
 
     def set_clock(self, clock: Optional[Callable[[], float]]) -> None:
@@ -475,7 +839,12 @@ def summary_line() -> str:
     )
 
 
-def trace_document(max_spans: int = DEFAULT_DUMP_SPANS) -> dict:
+DEFAULT_ROUND_K = 8
+
+
+def trace_document(
+    max_spans: int = DEFAULT_DUMP_SPANS, rounds: int = DEFAULT_ROUND_K
+) -> dict:
     """The one-call forensic snapshot behind the ``/debug/verify_trace``
     RPC and the ``cometbft-tpu trace`` CLI: ring tail + per-stage latency
     summary + pipeline health (breaker states, cache hit rates, scheduler
@@ -487,6 +856,11 @@ def trace_document(max_spans: int = DEFAULT_DUMP_SPANS) -> dict:
     doc = {
         "tracing": tracer.snapshot(),
         "stages": tracer.stage_summary(),
+        # last-K merged consensus-round timelines (cross-node when the
+        # fabric propagates contexts); rounds <= 0 skips the section body
+        "rounds": tracer.rounds_report(last_k=max(0, int(rounds)) or None)
+        if rounds > 0
+        else {},
         # max_spans <= 0 really means "health only, no span payload" —
         # tail()'s 0-means-all convention is for the dump path, not here
         "spans": tracer.tail(max_spans) if max_spans > 0 else [],

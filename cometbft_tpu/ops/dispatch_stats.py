@@ -21,6 +21,10 @@ just cumulative sums:
   * ``dispatch_hist[tier-lanes]`` — device dispatch WALL time per
     (supervisor tier, padding bucket): a sick lane is attributable to a
     shape and a tier from one scrape
+  * ``shard_hist[device]``       — per-device shard fetch wall time on the
+    mesh-sharded verify path (``parallel/mesh.fetch_sharded``): one sick
+    chip is ONE outlier series, visible per lane before multi-lane
+    flushing exists (ROADMAP item 1)
   * ``verify_hist``              — commit verification latency
 """
 
@@ -44,6 +48,7 @@ def _zero() -> dict:
         "verify_seconds": 0.0,
         "buckets": {},  # lanes -> dispatch count
         "dispatch_hist": {},  # "tier-lanes" -> Histo (wall seconds)
+        "shard_hist": {},  # device ordinal (str) -> Histo (wall seconds)
         "verify_hist": Histo(),
     }
 
@@ -69,6 +74,23 @@ def record_dispatch_time(impl: str, lanes: int, seconds: float) -> None:
         h = _STATS["dispatch_hist"].get(key)
         if h is None:
             h = _STATS["dispatch_hist"][key] = Histo(DISPATCH_BUCKETS_S)
+        h.observe(float(seconds))
+
+
+def record_shard_time(
+    impl: str, device: int, lanes: int, seconds: float
+) -> None:
+    """Wall time of one per-device shard fetch on the mesh path, keyed by
+    device ordinal — written by ``parallel/mesh.fetch_sharded``, rendered
+    as ``cometbft_crypto_shard_dispatch_seconds{device=}``.  ``impl`` and
+    ``lanes`` ride the span attribution; the histogram key stays the
+    device so a sick chip is one series regardless of bucket."""
+    del impl, lanes  # span attrs only; the metric dimension is the device
+    key = str(int(device))
+    with _LOCK:
+        h = _STATS["shard_hist"].get(key)
+        if h is None:
+            h = _STATS["shard_hist"][key] = Histo(DISPATCH_BUCKETS_S)
         h.observe(float(seconds))
 
 

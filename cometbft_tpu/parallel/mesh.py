@@ -17,6 +17,7 @@ and the collective cost is O(1).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional, Sequence
 
@@ -39,6 +40,8 @@ except ImportError:  # jax < 0.6 keeps it in experimental, check_rep kwarg
             check_rep=check_vma,
         )
 
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.ops import dispatch_stats
 from cometbft_tpu.ops import fe25519 as fe
 from cometbft_tpu.ops import verify as ov
 
@@ -74,13 +77,24 @@ def _verify_shard(a_bytes, r_bytes, s_bytes, m_bytes, s_ok, *, impl: str):
 _FN_CACHE: dict = {}
 
 
-def sharded_verify_fn(mesh: Mesh, impl: Optional[str] = None):
+def sharded_verify_fn(
+    mesh: Mesh, impl: Optional[str] = None, donated: bool = False
+):
     """jit-compiled mesh-sharded verifier.  Inputs are the packed batch arrays
     from ``ops.verify.prepare_batch`` padded to a multiple of the mesh size;
     raw byte arrays are (B, 32) sharded on the batch (lane) axis, scalars
-    (B,) sharded likewise.  ``impl`` overrides kernel selection (tests)."""
+    (B,) sharded likewise.  ``impl`` overrides kernel selection (tests).
+
+    ``donated=True`` donates all five input buffers (ROADMAP item 4's mesh
+    leftover): the packed arrays are repacked per dispatch and placed fresh
+    by ``device_put_args``, so the aliasing is safe by the same argument as
+    the single-chip hot loop (docs/warm-boot.md "Donated buffers") — XLA
+    reuses the shards' HBM for the kernel's scratch instead of allocating
+    alongside them."""
     impl = impl or ov.select_impl(mesh.devices.flat)
-    key = (impl,) + tuple((d.platform, d.id) for d in mesh.devices.flat)
+    key = (impl, bool(donated)) + tuple(
+        (d.platform, d.id) for d in mesh.devices.flat
+    )
     if key in _FN_CACHE:
         return _FN_CACHE[key]
     batch_first, vec = mesh_shardings(mesh)
@@ -103,7 +117,10 @@ def sharded_verify_fn(mesh: Mesh, impl: Optional[str] = None):
         # single psum, so the vma checker adds no safety here.
         check_vma=False,
     )
-    out = (jax.jit(fn), (batch_first, vec))
+    jitted = jax.jit(
+        fn, donate_argnums=tuple(range(5)) if donated else ()
+    )
+    out = (jitted, (batch_first, vec))
     _FN_CACHE[key] = out
     return out
 
@@ -111,31 +128,43 @@ def sharded_verify_fn(mesh: Mesh, impl: Optional[str] = None):
 _CALL_CACHE: dict = {}
 
 
-def mesh_tag(impl: str, n_dev: int, lanes: int) -> str:
+def mesh_tag(impl: str, n_dev: int, lanes: int, donated: bool = False) -> str:
     """On-disk exec-cache tag for one (kernel, topology, bucket) mesh
     executable — what lets a restarted dry-run/bench process load the
-    sharded executable instead of re-lowering per shard count."""
-    return f"mesh-{impl}-{n_dev}dev-{lanes}"
+    sharded executable instead of re-lowering per shard count.  Donation
+    changes the compiled artifact (input aliasing), so donated executables
+    get their own entry, mirroring ``ops.verify.bucket_tag``."""
+    base = f"mesh-{impl}-{n_dev}dev-{lanes}"
+    return base + "-donated" if donated else base
 
 
-def sharded_verify_call(mesh: Mesh, lanes: int, impl: Optional[str] = None):
+def sharded_verify_call(
+    mesh: Mesh,
+    lanes: int,
+    impl: Optional[str] = None,
+    donated: Optional[bool] = None,
+):
     """AOT-cached mesh-sharded verify executable for a ``lanes``-lane
     padded batch: returns (call, info).  ``call(*device_put_args(...))``
     runs it.  The executable is resolved through ``ops.aot_cache`` —
     deserialized from disk when a previous process compiled this
-    (impl, topology, lanes) shape (the multichip dry-run's 10240-sig
-    commit no longer re-lowers on every invocation) — and memoized per
-    process.  Falls back to the plain jitted path when AOT lowering or
-    the plugin's serialization can't handle the sharded computation."""
+    (impl, topology, lanes, donated) shape (the multichip dry-run's
+    10240-sig commit no longer re-lowers on every invocation) — and
+    memoized per process.  Falls back to the plain jitted path when AOT
+    lowering or the plugin's serialization can't handle the sharded
+    computation.  ``donated`` defaults to the single-chip donation policy
+    (``ops.verify.donation_enabled`` — Pallas/TPU on, CPU CI off)."""
     impl = impl or ov.select_impl(mesh.devices.flat)
+    if donated is None:
+        donated = ov.donation_enabled()
     n_dev = mesh.devices.size
-    key = (impl, lanes) + tuple(
+    key = (impl, lanes, bool(donated)) + tuple(
         (d.platform, d.id) for d in mesh.devices.flat
     )
     hit = _CALL_CACHE.get(key)
     if hit is not None:
         return hit, {"exec_cache": "memo"}
-    jitted, _ = sharded_verify_fn(mesh, impl)
+    jitted, _ = sharded_verify_fn(mesh, impl, donated=donated)
     if not ov.aot_enabled():
         return jitted, {"exec_cache": "disabled"}
     from cometbft_tpu.ops import aot_cache
@@ -151,7 +180,7 @@ def sharded_verify_call(mesh: Mesh, lanes: int, impl: Optional[str] = None):
     )
     try:
         call, info = aot_cache.load_or_compile(
-            jitted, specs, mesh_tag(impl, n_dev, lanes)
+            jitted, specs, mesh_tag(impl, n_dev, lanes, donated)
         )
     except Exception as e:  # noqa: BLE001 — sharded AOT unsupported here:
         # the jitted path compiles lazily exactly as before; memoize the
@@ -209,16 +238,71 @@ def pad_to_mesh(arrays: dict, mesh: Mesh) -> dict:
     return out
 
 
+def fetch_sharded(accept, mesh: Mesh, impl: str, lanes: int) -> np.ndarray:
+    """Fetch the sharded accept bits shard-by-shard, one ``mesh.shard``
+    child span per device carrying the (device ordinal, lanes-per-shard,
+    tier) attribution plus the shard's local accept count — the per-lane
+    visibility ROADMAP item 1 needs: a slow or sick chip shows up as ONE
+    outlier shard-fetch latency (and its histogram on
+    ``cometbft_crypto_shard_dispatch_seconds{device=}``), not as an opaque
+    slow dispatch.  Falls back to a plain global fetch when the result is
+    not shard-addressable (already-fetched arrays, single device)."""
+    n_dev = int(mesh.devices.size)
+    per = lanes // n_dev if n_dev else lanes
+    shards = getattr(accept, "addressable_shards", None)
+    if not shards or len(shards) != n_dev or per * n_dev != lanes:
+        return np.asarray(accept)
+    ordinal = {d.id: i for i, d in enumerate(mesh.devices.flat)}
+    out = np.zeros(lanes, dtype=bool)
+    for sh in sorted(
+        shards, key=lambda s: ordinal.get(s.device.id, 1 << 30)
+    ):
+        dev = ordinal.get(sh.device.id, -1)
+        t0 = time.perf_counter()
+        with tracing.span(
+            "mesh.shard", device=dev, lanes=per, tier=impl
+        ) as sp:
+            data = np.asarray(sh.data)
+            sp.set(ok=int(data.sum()))
+        start = sh.index[0].start or 0
+        out[start : start + data.shape[0]] = data
+        dispatch_stats.record_shard_time(
+            impl, dev, per, time.perf_counter() - t0
+        )
+    return out
+
+
 def verify_batch_sharded(
     pubs: Sequence[bytes],
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
     mesh: Optional[Mesh] = None,
+    donated: Optional[bool] = None,
 ) -> np.ndarray:
-    """Mesh-sharded analogue of ``ops.verify.verify_batch``; returns (n,) bool."""
+    """Mesh-sharded analogue of ``ops.verify.verify_batch``; returns (n,) bool.
+
+    The dispatch records the same ``verify.dispatch`` attribution triple as
+    the single-chip paths — (tier, lanes, dispatch ordinal) — extended with
+    the mesh width, and the fetch emits per-device ``mesh.shard`` child
+    spans (``fetch_sharded``)."""
     mesh = mesh or make_mesh()
+    impl = ov.select_impl(mesh.devices.flat)
     arrays, n, structural = ov.prepare_batch(pubs, msgs, sigs)
     arrays = pad_to_mesh(arrays, mesh)
-    call, _ = sharded_verify_call(mesh, arrays["s_ok"].shape[0])
-    accept, _ = call(*device_put_args(arrays, mesh))
-    return (np.asarray(accept)[: len(structural)] & structural)[:n]
+    lanes = arrays["s_ok"].shape[0]
+    dispatch_stats.record_dispatch(lanes, n)
+    seq = dispatch_stats.dispatch_count()
+    t0 = time.perf_counter()
+    with tracing.span(
+        "verify.dispatch",
+        tier=impl,
+        lanes=lanes,
+        n=n,
+        dispatch=seq,
+        mesh=int(mesh.devices.size),
+    ):
+        call, _ = sharded_verify_call(mesh, lanes, impl, donated=donated)
+        accept, _ = call(*device_put_args(arrays, mesh))
+        host = fetch_sharded(accept, mesh, impl, lanes)
+    dispatch_stats.record_dispatch_time(impl, lanes, time.perf_counter() - t0)
+    return (host[: len(structural)] & structural)[:n]

@@ -687,18 +687,21 @@ class Environment:
 
     # -- observability (docs/observability.md) -----------------------------
 
-    def debug_verify_trace(self, spans: int = 256) -> dict:
+    def debug_verify_trace(self, spans: int = 256, rounds: int = 8) -> dict:
         """One JSON document snapshotting the verify pipeline: flight-
-        recorder ring tail + per-stage latency summary + health (breaker
-        states, signature-cache hit rates, scheduler queue, warm-boot
-        progress).  Served as ``/debug/verify_trace`` (GET) and the
+        recorder ring tail + per-stage latency summary + the last-K merged
+        consensus-round timelines (per-step p50/p99, quorum-arrival times,
+        commit-to-proposal trace linkage) + health (breaker states,
+        signature-cache hit rates, scheduler queue, warm-boot progress).
+        Served as ``/debug/verify_trace`` (GET) and the
         ``debug_verify_trace`` JSON-RPC method; the ``cometbft-tpu
         trace`` CLI renders it.  Every read is jax-free by design — this
         endpoint must work exactly when the node is sickest."""
         from cometbft_tpu.libs import tracing
 
         doc = tracing.trace_document(
-            max_spans=max(0, min(int(spans), 4096))
+            max_spans=max(0, min(int(spans), 4096)),
+            rounds=max(0, min(int(rounds), 256)),
         )
         node = self.node
         ctx: dict = {}

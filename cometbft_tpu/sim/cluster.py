@@ -155,8 +155,22 @@ class SimCluster:
             app_conns=app_conns,
         )
         self._dbs[i] = node.block_store._db
-        node.cs.broadcast_hook = lambda msg, i=i: self.net.send(i, msg)
+        # gossip envelopes carry the sender's round-trace context so
+        # consensus-round spans merge into one causal tree per (height,
+        # round) across the cluster (docs/observability.md); the context
+        # is read at send time — the anchor may have been adopted since
+        node.cs.trace_origin = i
+        node.cs.broadcast_hook = lambda msg, i=i: self._broadcast(i, msg)
         return node
+
+    def _broadcast(self, i: int, msg) -> None:
+        node = self.nodes[i]
+        ctx = None
+        if node is not None:
+            # current_trace_ctx already gates on tracing.xnode_enabled()
+            tc = node.cs.current_trace_ctx()
+            ctx = tc.encode() if tc is not None else None
+        self.net.send(i, msg, ctx=ctx)
 
     def live_nodes(self) -> list[NodeHandle]:
         return [n for n in self.nodes if n is not None]
@@ -429,12 +443,12 @@ class SimCluster:
 
     # -- event loop --------------------------------------------------------
 
-    def _on_deliver(self, dst: int, src: int, msg) -> None:
+    def _on_deliver(self, dst: int, src: int, msg, ctx=None) -> None:
         node = self.nodes[dst]
         if node is None or not node.cs.is_running:
             return
         self._log("deliver %d->%d %s" % (src, dst, describe_msg(msg)))
-        node.cs.add_peer_message(msg, peer_id=f"node{src}")
+        node.cs.add_peer_message(msg, peer_id=f"node{src}", trace_ctx=ctx)
 
     def _drain_all(self) -> None:
         progress = True

@@ -50,7 +50,8 @@ class NetStats:
 
 class SimNetwork:
     """Fabric between ``n`` nodes; delivery goes through ``deliver_fn(dst,
-    src, msg)`` which the cluster installs."""
+    src, msg, ctx)`` which the cluster installs (``ctx`` is the optional
+    trace context the gossip envelope carried)."""
 
     def __init__(self, clock: VirtualClock, rng: random.Random, n: int):
         self.clock = clock
@@ -63,7 +64,9 @@ class SimNetwork:
             if i != j
         }
         self._group_of: Optional[dict[int, int]] = None  # node -> group id
-        self.deliver_fn: Optional[Callable[[int, int, object], None]] = None
+        self.deliver_fn: Optional[
+            Callable[[int, int, object, object], None]
+        ] = None
         self.alive_fn: Callable[[int], bool] = lambda _i: True
         self.stats = NetStats()
 
@@ -105,19 +108,22 @@ class SimNetwork:
 
     # -- traffic -----------------------------------------------------------
 
-    def send(self, src: int, msg: object) -> None:
+    def send(self, src: int, msg: object, ctx=None) -> None:
         """Broadcast from ``src`` to every other live node (push gossip,
-        mirroring the loopback harness this package grew out of)."""
+        mirroring the loopback harness this package grew out of).  ``ctx``
+        is an optional encoded trace context riding the envelope
+        (docs/observability.md "Cross-node tracing") — delivered to
+        ``deliver_fn`` alongside the message, dropped with it."""
         for dst in range(self.n):
             if dst == src:
                 continue
-            self._schedule(src, dst, msg)
+            self._schedule(src, dst, msg, ctx)
 
-    def unicast(self, src: int, dst: int, msg: object) -> None:
+    def unicast(self, src: int, dst: int, msg: object, ctx=None) -> None:
         """Point-to-point send through the same faulty link (catchup)."""
-        self._schedule(src, dst, msg)
+        self._schedule(src, dst, msg, ctx)
 
-    def _schedule(self, src: int, dst: int, msg: object) -> None:
+    def _schedule(self, src: int, dst: int, msg: object, ctx=None) -> None:
         cfg = self.links[(src, dst)]
         self.stats.sent += 1
         if not self.connected(src, dst):
@@ -136,7 +142,7 @@ class SimNetwork:
                 delay += self.rng.uniform(0.0, cfg.reorder_jitter)
             self.clock.call_later(
                 delay,
-                lambda s=src, d=dst, m=msg: self._deliver(s, d, m),
+                lambda s=src, d=dst, m=msg, c=ctx: self._deliver(s, d, m, c),
                 label=f"net {src}->{dst}",
             )
 
@@ -174,7 +180,7 @@ class SimNetwork:
         self.clock.call_later(delay, deliver, label=f"net {label} {src}->{dst}")
         return True
 
-    def _deliver(self, src: int, dst: int, msg: object) -> None:
+    def _deliver(self, src: int, dst: int, msg: object, ctx=None) -> None:
         if not self.connected(src, dst):
             self.stats.dropped_partition += 1
             return
@@ -182,4 +188,4 @@ class SimNetwork:
             return  # crashed endpoints: traffic dies with the process
         self.stats.delivered += 1
         if self.deliver_fn is not None:
-            self.deliver_fn(dst, src, msg)
+            self.deliver_fn(dst, src, msg, ctx)

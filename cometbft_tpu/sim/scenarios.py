@@ -190,6 +190,32 @@ class ScenarioResult:
                     for stage, s in self.spans.get("stages", {}).items()
                 },
             }
+            rounds = self.spans.get("rounds") or {}
+            if rounds.get("rounds_seen"):
+                # the round-timeline row: per-step p50/p99 (virtual ms),
+                # quorum-arrival percentiles and the commit-to-proposal
+                # linkage counts — a consensus latency regression is a
+                # diffable soak column, not a rerun
+                row["spans"]["rounds"] = {
+                    "seen": rounds["rounds_seen"],
+                    "commits_linked": rounds.get("commits_linked", 0),
+                    "commits_unlinked": rounds.get("commits_unlinked", 0),
+                    "steps": {
+                        step: {
+                            "p50_ms": s.get("p50_ms", 0.0),
+                            "p99_ms": s.get("p99_ms", 0.0),
+                        }
+                        for step, s in rounds.get("steps", {}).items()
+                    },
+                    "quorum": {
+                        k: {
+                            "p50_ms": q.get("p50_ms", 0.0),
+                            "p99_ms": q.get("p99_ms", 0.0),
+                        }
+                        for k, q in rounds.get("quorum", {}).items()
+                        if q.get("count")
+                    },
+                }
         return row
 
 
@@ -391,12 +417,30 @@ def _backend_brownout(s: Scenario) -> list[Action]:
         )
         _install_victim_injector(c, supervisor.FaultyBackend("raise"))
 
+    def aux_breakers(c: SimCluster) -> None:
+        """Fail the single-tier secp256k1/BLS device breakers mid-brownout
+        through the SAME supervised protocol the batch verifiers use: with
+        the scenario's threshold of 1 each failure opens its breaker, and
+        each breaker kind must produce its OWN anomaly dump — the ed25519
+        brownout's breaker_open dump must not eat them
+        (docs/observability.md anomaly taxonomy)."""
+
+        def boom() -> None:
+            raise RuntimeError("sim aux-device fault")
+
+        for name in ("secp_device", "bls_g1"):
+            out = supervisor.supervised_device_call(name, boom)
+            c._log(
+                "scenario: %s breaker poked (supervised -> %s)" % (name, out)
+            )
+
     def up(c: SimCluster) -> None:
         c._log("scenario: device backend restored")
         supervisor.clear_fault_injector()
 
     return [
         Action(5.0, "device backend brownout (f+1 nodes)", down),
+        Action(6.0, "secp/bls device breakers fail", aux_breakers),
         Action(10.0, "restore device backend", up),
     ]
 
@@ -1397,6 +1441,13 @@ def run_scenario(
             "anomalies": tsnap["anomalies"],
             "stages": _tracer.stage_summary(),
             "dumps": dumps,
+            # merged cross-node round timelines (the whole ring window):
+            # per-(height, round) causal trees rooted at the originating
+            # proposal, per-step p50/p99, quorum-arrival percentiles and
+            # the commit-to-proposal trace linkage counts.  A pure
+            # function of the seed — determinism tests byte-compare its
+            # sort_keys JSON across same-seed runs.
+            "rounds": _tracer.rounds_report(),
         }
     finally:
         _tracer.set_clock(None)

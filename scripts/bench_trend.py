@@ -1,0 +1,371 @@
+"""Perf-trend regression gate over the repo's bench artifacts.
+
+Six rounds of ``BENCH_r*.json`` existed with zero automated comparison: a
+perf regression — the thing the committee-consensus measurements in
+arXiv:2302.00418 show dominates commit cost — would have shipped silently.
+This harness gives the bench trajectory teeth:
+
+  1. **Ingest**: every ``BENCH_*.json`` / ``MULTICHIP_*.json`` driver
+     artifact (the ``{n, cmd, rc, tail}`` shape whose ``tail`` holds the
+     bench's JSON result lines) plus any ``sim_soak*.json`` trend report is
+     flattened into one consolidated ``BENCH_HISTORY.jsonl`` — one record
+     per (round, stage), metrics only.
+  2. **Trend**: for each stage, the newest record is compared per-metric
+     against the mean of a configurable baseline window of earlier records
+     (``--window``, default 3).
+  3. **Gate** (``--check``): HARD metrics — dispatch counts per 1k sigs,
+     cache-hit / occupancy / overhead ratios, anything that is a pure
+     function of the pipeline's shape rather than of host speed — fail the
+     run when they regress beyond the noise band
+     (``--noise-pct`` / ``COMETBFT_TPU_TREND_NOISE_PCT``, default 10%).
+     Wall-time and throughput deltas are ADVISORY only: the CI host is
+     throttled and its absolute numbers are meaningless (BENCH_r04 vs r01:
+     239 vs 17054 verifies/s purely from losing the chip).
+
+Usage:
+    python scripts/bench_trend.py              # rebuild history + table
+    python scripts/bench_trend.py --check      # gate (scripts/gate.sh)
+    python scripts/bench_trend.py --check --history COPY.jsonl --no-rebuild
+                                               # gate a pinned history file
+
+The classification is by metric-name pattern so new bench stages inherit
+gating without edits here:
+
+  * hard, lower-is-better:  ``*dispatches_per_1k*``, ``*_overhead_pct``,
+    ``*round_trips_per_1k*``
+  * hard, higher-is-better: ``*occupancy*``, ``*hit_rate*``
+  * advisory: every other numeric metric (throughputs, latencies, walls)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+DEFAULT_WINDOW = 3
+DEFAULT_NOISE_PCT = 10.0
+
+# artifact name -> (round number, family) — "BENCH_r05.json" sorts as
+# round 5 of family "bench"; unnumbered files get round 0
+_NAME_RE = re.compile(r"^([A-Z_]+?)_?r?(\d+)?\.json$")
+
+# metric-name patterns -> direction ("lower"/"higher" is BETTER)
+_HARD_PATTERNS = (
+    (re.compile(r"dispatches_per_1k"), "lower"),
+    (re.compile(r"round_trips_per_1k"), "lower"),
+    (re.compile(r"_overhead_pct$"), "lower"),
+    (re.compile(r"occupancy"), "higher"),
+    (re.compile(r"hit_rate"), "higher"),
+)
+
+
+def classify(metric: str):
+    """(kind, direction): ("hard", "lower"/"higher") or ("advisory", None)."""
+    for pat, direction in _HARD_PATTERNS:
+        if pat.search(metric):
+            return "hard", direction
+    return "advisory", None
+
+
+def _numeric_metrics(obj: dict) -> dict:
+    """The gateable subset of one bench result line: finite numbers only,
+    minus identifiers and driver bookkeeping that merely parameterize the
+    stage (a process return code or run counter is not a perf metric)."""
+    skip = {"vs_baseline", "rc", "n", "n_devices", "seed", "reps", "batch"}
+    out = {}
+    for k, v in obj.items():
+        if k in skip or isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)) and v == v and abs(v) != float("inf"):
+            out[k] = v
+    return out
+
+
+def _parse_artifact(path: str) -> "list[dict]":
+    """Records from one driver artifact: one record per JSON result line
+    in the ``tail`` (the ``{n, cmd, rc, tail}`` driver shape), or one
+    record from the top-level numerics when the artifact IS a flat result
+    object (BENCH_BLS_r05.json).  Stages are namespaced by artifact family
+    ("bench_bls:final", "multichip:final") so different workloads never
+    trend against each other; the primary BENCH_r* family keeps bare
+    stage names."""
+    name = os.path.basename(path)
+    m = _NAME_RE.match(name)
+    rnd = int(m.group(2)) if m and m.group(2) else 0
+    family = (m.group(1).rstrip("_").lower() if m else "bench")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+
+    def mk(obj: dict) -> "dict | None":
+        stage = str(obj.get("stage") or "final")
+        if family != "bench":
+            stage = f"{family}:{stage}"
+        metrics = _numeric_metrics(obj)
+        if not metrics:
+            return None
+        return {
+            "source": name,
+            "round": rnd,
+            "stage": stage,
+            "metrics": metrics,
+        }
+
+    records = []
+    tail = doc.get("tail", "")
+    if isinstance(tail, str):
+        for line in tail.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            rec = mk(obj)
+            if rec is not None:
+                records.append(rec)
+    if not records:
+        # flat result object, or a driver artifact whose tail carried no
+        # JSON lines (MULTICHIP skip rounds): trend the top-level numbers
+        rec = mk(doc)
+        if rec is not None:
+            records.append(rec)
+    return records
+
+
+def _parse_sim_soak(path: str) -> "list[dict]":
+    """Records from a sim_soak/soak-matrix trend JSON: per-scenario wall
+    seconds and event counts (advisory — virtual-time behavior is gated by
+    the sim's own invariants, not here)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    rows = doc.get("rows")
+    if not isinstance(rows, list):
+        return []
+    agg: dict = {}
+    for row in rows:
+        if not isinstance(row, dict) or "scenario" not in row:
+            continue
+        a = agg.setdefault(
+            row["scenario"], {"wall_seconds": 0.0, "events": 0, "cells": 0}
+        )
+        a["wall_seconds"] += float(row.get("wall_seconds", 0.0))
+        a["events"] += int(row.get("events", 0))
+        a["cells"] += 1
+    return [
+        {
+            "source": name,
+            "round": 0,
+            "stage": f"sim:{scenario}",
+            "metrics": dict(m),
+        }
+        for scenario, m in sorted(agg.items())
+    ]
+
+
+def collect_records(root: str = REPO) -> "list[dict]":
+    """Every record the repo's artifacts yield, oldest round first (the
+    order the trend window consumes them in)."""
+    records: list[dict] = []
+    for pattern in ("BENCH_*.json", "MULTICHIP_*.json"):
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            records.extend(_parse_artifact(path))
+    for path in sorted(glob.glob(os.path.join(root, "sim_soak*.json"))):
+        records.extend(_parse_sim_soak(path))
+    records.sort(key=lambda r: (r["round"], r["source"], r["stage"]))
+    return records
+
+
+def write_history(records: "list[dict]", path: str) -> None:
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def read_history(path: str) -> "list[dict]":
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            records.append(json.loads(line))
+    return records
+
+
+def check_trend(
+    records: "list[dict]",
+    window: int = DEFAULT_WINDOW,
+    noise_pct: float = DEFAULT_NOISE_PCT,
+) -> "tuple[list[dict], list[str]]":
+    """(table rows, hard regressions).  Per stage: the LAST record is the
+    candidate; the up-to-``window`` records before it are the baseline.
+    A stage with no earlier record has no baseline and gates nothing."""
+    by_stage: dict = {}
+    for rec in records:
+        by_stage.setdefault(rec["stage"], []).append(rec)
+    rows: list[dict] = []
+    regressions: list[str] = []
+    for stage in sorted(by_stage):
+        series = by_stage[stage]
+        if len(series) < 2:
+            continue
+        latest = series[-1]
+        baseline_recs = series[-1 - window : -1]
+        for metric in sorted(latest["metrics"]):
+            base_vals = [
+                r["metrics"][metric]
+                for r in baseline_recs
+                if metric in r["metrics"]
+            ]
+            if not base_vals:
+                continue
+            base = sum(base_vals) / len(base_vals)
+            cur = latest["metrics"][metric]
+            kind, direction = classify(metric)
+            if base == 0:
+                delta_pct = 0.0 if cur == 0 else float("inf")
+            else:
+                delta_pct = 100.0 * (cur - base) / abs(base)
+            worse = (
+                delta_pct > noise_pct
+                if direction == "lower"
+                else -delta_pct > noise_pct
+                if direction == "higher"
+                else False
+            )
+            verdict = "ok"
+            if kind == "hard" and worse:
+                verdict = "REGRESSION"
+                regressions.append(
+                    f"{stage}/{metric}: {base:.4g} -> {cur:.4g} "
+                    f"({delta_pct:+.1f}%, band {noise_pct:g}%)"
+                )
+            rows.append(
+                {
+                    "stage": stage,
+                    "metric": metric,
+                    "baseline": base,
+                    "latest": cur,
+                    "delta_pct": delta_pct,
+                    "kind": kind,
+                    "verdict": verdict,
+                    "n_baseline": len(base_vals),
+                }
+            )
+    return rows, regressions
+
+
+def print_table(rows: "list[dict]", hard_only: bool = False) -> None:
+    print(
+        f"{'stage':18s} {'metric':28s} {'baseline':>12s} {'latest':>12s} "
+        f"{'delta%':>8s} {'class':>8s} verdict"
+    )
+    for r in rows:
+        if hard_only and r["kind"] != "hard":
+            continue
+        print(
+            "%-18s %-28s %12.4g %12.4g %8.1f %8s %s"
+            % (
+                r["stage"][:18],
+                r["metric"][:28],
+                r["baseline"],
+                r["latest"],
+                r["delta_pct"],
+                r["kind"],
+                r["verdict"],
+            )
+        )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--history", default=DEFAULT_HISTORY,
+        help=f"consolidated history file (default {DEFAULT_HISTORY})",
+    )
+    ap.add_argument(
+        "--no-rebuild", action="store_true",
+        help="gate the history file as-is instead of re-ingesting the "
+             "repo artifacts (pinned-history tests)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="exit nonzero on hard-metric regressions beyond the noise band",
+    )
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW)
+    ap.add_argument(
+        "--noise-pct", type=float,
+        default=float(
+            os.environ.get("COMETBFT_TPU_TREND_NOISE_PCT", DEFAULT_NOISE_PCT)
+        ),
+        help="hard-metric noise band in percent (default "
+             f"{DEFAULT_NOISE_PCT:g}; env COMETBFT_TPU_TREND_NOISE_PCT)",
+    )
+    ap.add_argument(
+        "--hard-only", action="store_true",
+        help="print only the gated (hard) metric rows",
+    )
+    args = ap.parse_args()
+
+    if args.no_rebuild:
+        try:
+            records = read_history(args.history)
+        except OSError as e:
+            print(f"bench-trend: cannot read {args.history}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        records = collect_records()
+        write_history(records, args.history)
+        print(
+            f"bench-trend: ingested {len(records)} records -> {args.history}"
+        )
+
+    rows, regressions = check_trend(
+        records, window=args.window, noise_pct=args.noise_pct
+    )
+    if not rows:
+        print("bench-trend: no stage has enough history to trend yet")
+        return 0
+    print_table(rows, hard_only=args.hard_only)
+    n_hard = sum(1 for r in rows if r["kind"] == "hard")
+    print(
+        f"bench-trend: {len(rows)} trended metrics ({n_hard} gated hard), "
+        f"{len(regressions)} regressions, noise band {args.noise_pct:g}%"
+    )
+    if n_hard == 0:
+        # a vacuous gate must be VISIBLE: until the committed artifacts
+        # carry stage lines with dispatch/occupancy/hit-rate metrics (the
+        # driver snapshots them from bench.py's stage output), --check can
+        # only watch the advisory columns
+        print(
+            "bench-trend: WARNING no hard metrics in history yet — the "
+            "gate is advisory-only until BENCH artifacts carry "
+            "dispatch/occupancy/hit-rate stage lines",
+            file=sys.stderr,
+        )
+    for line in regressions:
+        print(f"bench-trend: REGRESSION {line}", file=sys.stderr)
+    if args.check and regressions:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

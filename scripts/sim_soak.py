@@ -100,6 +100,33 @@ def _row_extra(row: dict) -> str:
             extra += " p99ms=" + ",".join(
                 "%s:%.1f" % (stage.split(".")[-1], ms) for stage, ms in worst
             )
+        rounds = spans.get("rounds") or {}
+        if rounds:
+            # merged cross-node round timeline: commit-to-proposal linkage
+            # plus per-step p99 (virtual ms) — the consensus-latency shape
+            # of the run in one diffable column
+            extra += " rounds=%d link=%d/%d" % (
+                rounds.get("seen", 0),
+                rounds.get("commits_linked", 0),
+                rounds.get("commits_linked", 0)
+                + rounds.get("commits_unlinked", 0),
+            )
+            steps = rounds.get("steps") or {}
+            if steps:
+                extra += " step_p99=" + ",".join(
+                    "%s:%.0f"
+                    % (
+                        step.replace("RoundStep", "").lower(),
+                        s.get("p99_ms", 0.0),
+                    )
+                    for step, s in sorted(steps.items())
+                )
+            quorum = rounds.get("quorum") or {}
+            if quorum:
+                extra += " q_p99=" + ",".join(
+                    "%s:%.0f" % (k.split("_")[0], q.get("p99_ms", 0.0))
+                    for k, q in sorted(quorum.items())
+                )
     return extra
 
 

@@ -1,0 +1,166 @@
+"""Perf-trend regression harness (ISSUE 11, scripts/bench_trend.py):
+artifact ingestion, hard/advisory metric classification, baseline-window
+deltas, and the acceptance pin — the repo's CURRENT history passes the
+gate while a synthetic +30% dispatches-per-1k regression injected into a
+COPY of BENCH_HISTORY.jsonl fails it."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+
+import bench_trend as bt  # noqa: E402
+
+REPO = Path(__file__).parent.parent
+
+
+def _sched_record(rnd: int, dispatches: float, occupancy: float = 0.9):
+    return {
+        "source": f"BENCH_r{rnd:02d}.json",
+        "round": rnd,
+        "stage": "sched",
+        "metrics": {
+            "sched_dispatches_per_1k": dispatches,
+            "sched_occupancy": occupancy,
+            "sched_sigs_per_s": 1000.0 + rnd,
+        },
+    }
+
+
+class TestClassification:
+    def test_hard_metric_patterns(self):
+        assert bt.classify("sched_dispatches_per_1k") == ("hard", "lower")
+        assert bt.classify("sync_dispatches_per_1k") == ("hard", "lower")
+        assert bt.classify("app_round_trips_per_1k") == ("hard", "lower")
+        assert bt.classify("enabled_overhead_pct") == ("hard", "lower")
+        assert bt.classify("sched_occupancy") == ("hard", "higher")
+        assert bt.classify("batch_occupancy") == ("hard", "higher")
+        assert bt.classify("hit_rate") == ("hard", "higher")
+
+    def test_advisory_metrics_never_gate(self):
+        assert bt.classify("sched_sigs_per_s") == ("advisory", None)
+        assert bt.classify("value") == ("advisory", None)
+        assert bt.classify("wall_seconds") == ("advisory", None)
+        assert bt.classify("sched_p99_ms") == ("advisory", None)
+
+
+class TestIngestion:
+    def test_repo_artifacts_ingest_and_pass(self):
+        """The committed BENCH_*.json rounds build a non-empty history
+        that the gate accepts — the 'teeth' must not bite the healthy
+        trajectory."""
+        records = bt.collect_records(str(REPO))
+        assert records, "repo artifacts must yield history records"
+        assert any(r["stage"] == "final" for r in records)
+        rows, regressions = bt.check_trend(records)
+        assert regressions == [], regressions
+
+    def test_family_namespacing(self, tmp_path):
+        """BENCH_BLS must not trend against the primary BENCH family even
+        when both emit a 'final' stage."""
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+            "tail": '{"stage": "final", "value": 100.0}\n'
+        }))
+        (tmp_path / "BENCH_BLS_r01.json").write_text(json.dumps({
+            "metric": "bls", "value": 85.0, "single_verify_ms": 11.0
+        }))
+        records = bt.collect_records(str(tmp_path))
+        stages = {r["stage"] for r in records}
+        assert "final" in stages
+        assert "bench_bls:final" in stages
+
+    def test_sim_soak_rows_aggregate(self, tmp_path):
+        (tmp_path / "sim_soak_matrix.json").write_text(json.dumps({
+            "rows": [
+                {"scenario": "baseline", "wall_seconds": 1.5, "events": 100},
+                {"scenario": "baseline", "wall_seconds": 2.5, "events": 140},
+                {"scenario": "fleet-churn", "wall_seconds": 9.0, "events": 7},
+            ]
+        }))
+        records = bt.collect_records(str(tmp_path))
+        sim = {r["stage"]: r for r in records if r["stage"].startswith("sim:")}
+        assert sim["sim:baseline"]["metrics"] == {
+            "wall_seconds": 4.0, "events": 240, "cells": 2,
+        }
+        assert sim["sim:fleet-churn"]["metrics"]["cells"] == 1
+
+    def test_history_roundtrip(self, tmp_path):
+        records = [_sched_record(1, 10.4), _sched_record(2, 10.5)]
+        path = tmp_path / "h.jsonl"
+        bt.write_history(records, str(path))
+        assert bt.read_history(str(path)) == records
+
+
+class TestGate:
+    def test_synthetic_dispatch_regression_fails(self, tmp_path):
+        """THE acceptance pin: current history passes; a copy with a +30%
+        dispatches-per-1k tail record fails --check with rc 1."""
+        records = bt.collect_records(str(REPO))
+        base = 10.4
+        records += [
+            _sched_record(90, base),
+            _sched_record(91, base + 0.1),
+            _sched_record(92, base - 0.1),
+        ]
+        good = tmp_path / "good.jsonl"
+        bt.write_history(records, str(good))
+        rc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "bench_trend.py"),
+             "--check", "--no-rebuild", "--history", str(good)],
+            capture_output=True, text=True,
+        )
+        assert rc.returncode == 0, rc.stdout + rc.stderr
+
+        bad = tmp_path / "bad.jsonl"
+        bt.write_history(
+            records + [_sched_record(93, base * 1.30)], str(bad)
+        )
+        rc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "bench_trend.py"),
+             "--check", "--no-rebuild", "--history", str(bad)],
+            capture_output=True, text=True,
+        )
+        assert rc.returncode == 1, rc.stdout + rc.stderr
+        assert "sched_dispatches_per_1k" in rc.stderr
+
+    def test_occupancy_drop_fails(self):
+        records = [
+            _sched_record(1, 10.0, occupancy=0.9),
+            _sched_record(2, 10.0, occupancy=0.9),
+            _sched_record(3, 10.0, occupancy=0.6),  # -33%: cache/occupancy
+        ]
+        rows, regressions = bt.check_trend(records)
+        assert any("sched_occupancy" in r for r in regressions)
+
+    def test_advisory_throughput_collapse_does_not_gate(self):
+        """Losing the chip collapses throughput 70x (BENCH_r01 -> r04);
+        that is advisory — host-dependent walls must never fail CI."""
+        records = [
+            {"source": "BENCH_r01.json", "round": 1, "stage": "final",
+             "metrics": {"value": 17054.1}},
+            {"source": "BENCH_r04.json", "round": 4, "stage": "final",
+             "metrics": {"value": 238.9}},
+        ]
+        rows, regressions = bt.check_trend(records)
+        assert regressions == []
+        assert rows and rows[0]["kind"] == "advisory"
+
+    def test_noise_band_is_configurable(self):
+        records = [
+            _sched_record(1, 10.0),
+            _sched_record(2, 10.0),
+            _sched_record(3, 11.5),  # +15%
+        ]
+        _, tight = bt.check_trend(records, noise_pct=10.0)
+        assert tight
+        _, loose = bt.check_trend(records, noise_pct=20.0)
+        assert loose == []
+
+    def test_single_record_stage_has_no_baseline(self):
+        rows, regressions = bt.check_trend([_sched_record(1, 99.0)])
+        assert rows == [] and regressions == []

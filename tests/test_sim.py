@@ -278,7 +278,10 @@ class TestBackendFaultScenarios:
             cbatch._DEFAULT_BACKEND,
         )
 
-    def test_backend_brownout_agreement_and_repromotion(self, tmp_path):
+    def test_backend_brownout_agreement_and_repromotion(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")  # dump asserts below
         before = self._snapshot_globals()
         # underscore alias accepted (the issue names it backend_brownout)
         res = run_scenario(
@@ -293,6 +296,22 @@ class TestBackendFaultScenarios:
         assert b["repromotions"] >= 1, b  # restored after the brownout
         assert b["fallback_signatures"] > 0, b
         assert b["breakers"]["xla"] == "closed", b  # healthy again at end
+        # anomaly taxonomy (ISSUE 11): the ed25519 brownout AND the
+        # scripted secp/bls breaker failures each produce their OWN dump
+        # kind, exactly one dump per kind (first-occurrence latch)
+        anomalies = res.spans["anomalies"]
+        assert anomalies.get("breaker_open", 0) >= 1, anomalies
+        assert anomalies.get("breaker_open_secp_device", 0) == 1, anomalies
+        assert anomalies.get("breaker_open_bls_g1", 0) == 1, anomalies
+        dump_kinds = [
+            d["file"].split("-", 2)[2] for d in res.spans["dumps"]
+        ]
+        for kind in (
+            "breaker_open.jsonl",
+            "breaker_open_secp_device.jsonl",
+            "breaker_open_bls_g1.jsonl",
+        ):
+            assert dump_kinds.count(kind) == 1, res.spans["dumps"]
         # scenario teardown restored every piece of process-global state
         assert self._snapshot_globals() == before
 
@@ -354,6 +373,15 @@ class TestBackendFaultScenarios:
             a.spans["dumps"],
             b.spans["dumps"],
         )
+        # the merged CROSS-NODE round timeline replays byte-identically
+        # too: span ids, virtual times, quorum stamps and trace linkage
+        # are all pure functions of the seed (ISSUE 11)
+        import json as _json
+
+        ta = _json.dumps(a.spans["rounds"], sort_keys=True)
+        tb = _json.dumps(b.spans["rounds"], sort_keys=True)
+        assert ta == tb
+        assert a.spans["rounds"]["commits_unlinked"] == 0
 
     def test_backend_flap_breaker_cycles(self, tmp_path):
         res = run_scenario(
@@ -557,10 +585,16 @@ class TestFleetScale:
         assert 5 in sizes.values()  # the spare joined the set
         assert sizes[max(sizes)] == 4  # and node0 left it again
 
-    def test_fleet_churn_small_scale(self, tmp_path):
+    def test_fleet_churn_small_scale(self, tmp_path, monkeypatch):
         """ISSUE acceptance (tier-1 variant): rotation + churn — statesync
         join, graceful leave, crash-restart — at 8 validators on the
-        host-path seam; the 100-validator variant runs in the slow lane."""
+        host-path seam; the 100-validator variant runs in the slow lane.
+
+        PLUS the ISSUE 11 acceptance on the SAME run (one scenario run,
+        not two, for the tier-1 budget): the merged cross-node round
+        timeline must link every commit's verify spans back to the
+        originating proposal's trace id, with per-step p50/p99 rendered."""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")  # timeline asserts
         res = run_scenario(
             "fleet-churn", 3, root=tmp_path, n_vals=8,
             raise_on_violation=True,
@@ -574,6 +608,40 @@ class TestFleetScale:
         assert any("leave node7" in l for l in res.trace)
         assert res.heights[7] == -1  # the leaver stayed gone
         assert any("crash node1" in l for l in res.trace)
+        # -- merged cross-node round timeline (ISSUE 11 acceptance) ------
+        rep = res.spans["rounds"]
+        assert res.spans["dropped"] == 0  # the whole run fits the ring
+        assert rep["rounds_seen"] >= res.target_height
+        # every consensus-path verify.commit links to a round trace; zero
+        # broken linkage (standalone = checker/light verifies, separate)
+        assert rep["commits_linked"] > 0
+        assert rep["commits_unlinked"] == 0, rep
+        # every round that carried commit verify-work has a resolved root
+        # — the originating proposal's span — and the root is a proposer
+        committed = [g for g in rep["rounds"] if g["commits"] > 0]
+        assert committed
+        for g in committed:
+            assert g["trace"] is not None, g
+            assert g["origin"] is not None, (
+                "round (%s,%s) commits lack a root proposal" % (g["h"], g["r"])
+            )
+            # 8-validator cluster: the adopted members joined the
+            # proposer's tree over the gossip fabric
+            adopted = [n for n in g["nodes"] if n.get("adopted")]
+            assert adopted, g
+        # per-step latency percentiles render for the consensus steps
+        for step in ("RoundStepPropose", "RoundStepPrevote",
+                     "RoundStepPrecommit"):
+            assert rep["steps"][step]["count"] > 0, rep["steps"]
+            assert rep["steps"][step]["p99_ms"] >= 0.0
+        # quorum-arrival times landed on the round anchors
+        assert rep["quorum"]["prevote_ms"]["count"] > 0
+        assert rep["quorum"]["precommit_ms"]["count"] > 0
+        # and the soak-facing summary row carries the same shape
+        row = res.summary()["spans"]["rounds"]
+        assert row["seen"] == rep["rounds_seen"]
+        assert row["commits_unlinked"] == 0
+        assert "RoundStepPrevote" in row["steps"]
 
     def test_statesync_storm_joins_through_loss(self, tmp_path):
         """Two joiners statesync through 25%-lossy links while a serving
@@ -646,14 +714,24 @@ class TestFleetScale:
         assert any("partition minority" in l for l in res.trace)
 
     @pytest.mark.slow
-    def test_fleet_churn_deterministic(self, tmp_path):
+    def test_fleet_churn_deterministic(self, tmp_path, monkeypatch):
         """Same seed => byte-identical traces through statesync join,
-        graceful leave, crash-restart AND rotation in one run."""
+        graceful leave, crash-restart AND rotation in one run — and the
+        merged cross-node round timeline (ISSUE 11) replays byte-for-byte
+        with them: trace contexts on the gossip fabric add no
+        nondeterminism."""
+        import json as _json
+
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")
         a = run_scenario("fleet-churn", 17, root=tmp_path / "a")
         b = run_scenario("fleet-churn", 17, root=tmp_path / "b")
         assert a.trace == b.trace
         assert a.heights == b.heights
         assert a.rotations == b.rotations
+        ta = _json.dumps(a.spans["rounds"], sort_keys=True)
+        tb = _json.dumps(b.spans["rounds"], sort_keys=True)
+        assert ta == tb
+        assert a.spans["rounds"]["rounds_seen"] > 0
 
     @pytest.mark.slow
     def test_fleet_churn_100_validators(self, tmp_path):

@@ -511,3 +511,251 @@ class TestTraceDocument:
         )
         lines, ok = gate.trace_share(bad, wall=700.0)
         assert not ok and "FAIL" in lines[0]
+
+
+class TestCrossNode:
+    """Cross-node trace correlation (ISSUE 11): TraceContext codec,
+    explicit begin/finish/adopt/under span API, orphan tolerance and
+    ring-bound behavior under cross-node fan-in."""
+
+    def test_trace_context_roundtrip(self):
+        ctx = tracing.TraceContext(0x2A, 0x2B, origin=3)
+        dec = tracing.TraceContext.decode(ctx.encode())
+        assert dec == ctx
+        # origin-less contexts round-trip too (production p2p has no
+        # small-integer node index)
+        anon = tracing.TraceContext(7, 9)
+        assert tracing.TraceContext.decode(anon.encode()) == anon
+        # decode accepts an already-decoded context (idempotent)
+        assert tracing.TraceContext.decode(ctx) is ctx
+
+    def test_trace_context_garbage_tolerance(self):
+        """A malformed context must decode to None, never raise — the
+        gossip path treats it as absent (orphan-parent tolerance starts
+        at the codec)."""
+        bad = [
+            None,
+            b"2a.2b.3",          # wrong type
+            123,
+            "",                   # empty
+            "2a.2b",              # truncated
+            "2a.2b.3.4",          # too many fields
+            "zz.2b.3",            # non-hex trace
+            "2a.zz.3",            # non-hex span
+            "2a.2b.x",            # non-int origin
+            "0.2b.3",             # zero trace id
+            "-1.2b.3",            # negative
+        ]
+        for token in bad:
+            assert tracing.TraceContext.decode(token) is None, token
+
+    def test_begin_finish_under_links_children(self):
+        tr = tracing.get_tracer()
+        anchor = tr.begin("consensus.round", h=5, r=0, node=1)
+        assert anchor.parent_id is None and anchor.trace_id == anchor.span_id
+        with tr.under(anchor):
+            with tr.span("verify.commit", height=5):
+                pass
+        tr.finish(anchor, committed=True)
+        spans = {s["stage"]: s for s in tr.tail(10)}
+        assert spans["verify.commit"]["trace"] == anchor.trace_id
+        assert spans["verify.commit"]["parent"] == anchor.span_id
+        assert spans["consensus.round"]["attrs"]["committed"] is True
+        # finish is idempotent: a second call must not double-record
+        tr.finish(anchor)
+        assert tr.snapshot()["spans_recorded"] == 2
+
+    def test_adopt_reparents_rootless_only(self):
+        tr = tracing.get_tracer()
+        root = tr.begin("consensus.round", h=5, r=0, node=0)
+        ctx = tr.ctx_for(root, origin=0)
+        member = tr.begin("consensus.round", h=5, r=0, node=2)
+        assert tr.adopt(member, ctx)
+        assert member.trace_id == root.trace_id
+        assert member.parent_id == root.span_id
+        assert member.attrs["xnode"] == 0
+        # first adoption wins: a second ctx cannot re-root the member
+        other = tr.begin("consensus.round", h=5, r=1, node=3)
+        assert not tr.adopt(member, tr.ctx_for(other, origin=3))
+        assert member.trace_id == root.trace_id
+        # a finished span never adopts
+        tr.finish(root)
+        late = tr.begin("consensus.round", h=6, r=0, node=1)
+        tr.finish(late)
+        assert not tr.adopt(late, ctx)
+
+    def test_record_span_retroactive(self):
+        """consensus.step timing: manufactured spans carry explicit
+        timestamps and parent under the round anchor."""
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        tr = tracing.get_tracer()
+        tr.set_clock(clock)
+        try:
+            anchor = tr.begin("consensus.round", h=9, r=0)
+            tr.record_span(
+                "consensus.step", 1.0, 3.5, parent=anchor,
+                step="RoundStepPropose", h=9, r=0,
+            )
+            tr.finish(anchor)
+        finally:
+            tr.set_clock(None)
+        step = next(
+            s for s in tr.tail(10) if s["stage"] == "consensus.step"
+        )
+        assert step["dur_ms"] == 2500.0
+        assert step["parent"] == anchor.span_id
+        assert step["trace"] == anchor.trace_id
+
+    def test_xnode_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_TRACE_XNODE", "0")
+        assert not tracing.xnode_enabled()
+        monkeypatch.delenv("COMETBFT_TPU_TRACE_XNODE", raising=False)
+        assert tracing.xnode_enabled()
+        # the recorder kill switch implies no propagation either
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "0")
+        assert not tracing.xnode_enabled()
+        # disabled begin/finish/adopt/under degrade to no-ops
+        tr = tracing.get_tracer()
+        assert tr.begin("consensus.round", h=1, r=0) is None
+        tr.finish(None)
+        assert not tr.adopt(None, tracing.TraceContext(1, 1))
+        with tr.under(None):
+            pass
+        assert tr.snapshot()["spans_recorded"] == 0
+
+
+def _mk_round(tr, h, r, proposer, members, commits_per_node=1,
+              orphan_root=False):
+    """Synthesize one cross-node round on the shared tracer: the proposer
+    roots the trace, members adopt its context, each committing node runs
+    a verify.commit under its anchor.  ``orphan_root=True`` models a
+    crashed proposer: members adopt the context but the root span never
+    records."""
+    root = tr.begin("consensus.round", h=h, r=r, node=proposer)
+    root.set(proposer=True)
+    ctx = tr.ctx_for(root, origin=proposer)
+    anchors = []
+    for node in members:
+        sp = tr.begin("consensus.round", h=h, r=r, node=node)
+        tr.adopt(sp, ctx)
+        anchors.append(sp)
+    for sp in [root] + anchors:
+        tr.record_span(
+            "consensus.step", tr.time(), tr.time(), parent=sp,
+            step="RoundStepPropose", h=h, r=r, node=sp.attrs["node"],
+        )
+        with tr.under(sp):
+            for _ in range(commits_per_node):
+                with tr.span("verify.commit", height=h, sigs=4):
+                    pass
+        sp.set(q_prevote_ms=1.5, q_precommit_ms=2.5)
+    for sp in anchors:
+        tr.finish(sp, committed=True)
+    if not orphan_root:
+        tr.finish(root, committed=True)
+    return root
+
+
+class TestRoundsReport:
+    def test_merged_round_links_commits_to_proposal(self):
+        tr = tracing.get_tracer()
+        for h in (4, 5):
+            _mk_round(tr, h, 0, proposer=0, members=[1, 2, 3])
+        rep = tr.rounds_report()
+        assert rep["rounds_seen"] == 2
+        assert rep["commits_unlinked"] == 0
+        assert rep["commits_linked"] == 2 * 4  # 4 nodes x 1 commit x 2 rounds
+        g = rep["rounds"][0]
+        assert g["h"] == 4 and g["origin"] == 0
+        assert g["commits"] == 4
+        assert [n["node"] for n in g["nodes"]] == [0, 1, 2, 3]
+        assert all(
+            n["adopted"] == (n["node"] != 0) for n in g["nodes"]
+        )
+        assert rep["steps"]["RoundStepPropose"]["count"] == 8
+        assert rep["quorum"]["prevote_ms"]["p50_ms"] == 1.5
+
+    def test_orphan_root_tolerated(self):
+        """A crashed proposer's root span never records: the group still
+        renders — origin unknown, trace recovered from the adopted
+        members, commits still linked."""
+        tr = tracing.get_tracer()
+        _mk_round(tr, 7, 1, proposer=2, members=[0, 1], orphan_root=True)
+        rep = tr.rounds_report()
+        assert rep["rounds_seen"] == 1
+        g = rep["rounds"][0]
+        assert g["origin"] is None  # the root is missing...
+        assert g["trace"] is not None  # ...but the trace id survived
+        assert g["commits"] == 3  # root's commit spans linked by trace id
+        assert rep["commits_unlinked"] == 0
+
+    def test_ring_bound_under_cross_node_fan_in(self):
+        """A fleet fanning into a small ring: old rounds fall off, drops
+        are counted, and the report stays well-formed over the window
+        that remains."""
+        tr = tracing.Tracer(ring_size=64)
+        for h in range(1, 21):  # 20 rounds x 8 nodes >> 64 ring slots
+            _mk_round(tr, h, 0, proposer=h % 8,
+                      members=[n for n in range(8) if n != h % 8])
+        snap = tr.snapshot()
+        assert snap["spans_dropped"] > 0
+        rep = tr.rounds_report()
+        json.dumps(rep, sort_keys=True)  # serializable, no cycles
+        assert 0 < rep["rounds_seen"] <= 20
+        last = rep["rounds"][-1]
+        assert last["h"] == 20
+        # the newest round survives complete: root present, all commits
+        # linked within the window
+        assert last["origin"] == 20 % 8
+        assert last["commits"] == 8
+        # rounds straddling the ring edge may be partial but never invent
+        # linkage failures
+        assert rep["commits_unlinked"] == 0
+        # last_k trims the timeline but not the aggregates
+        rep2 = tr.rounds_report(last_k=2)
+        assert len(rep2["rounds"]) == 2
+        assert rep2["rounds_seen"] == rep["rounds_seen"]
+
+    def test_trace_document_rounds_section(self):
+        tr = tracing.get_tracer()
+        _mk_round(tr, 3, 0, proposer=1, members=[0, 2, 3])
+        doc = tracing.trace_document(max_spans=8, rounds=4)
+        assert doc["rounds"]["rounds_seen"] == 1
+        assert doc["rounds"]["rounds"][0]["origin"] == 1
+        json.dumps(doc)
+        # rounds=0 skips the section body (health-only probes)
+        doc0 = tracing.trace_document(max_spans=0, rounds=0)
+        assert doc0["rounds"] == {}
+
+    def test_rootless_non_proposer_never_claims_origin(self):
+        """A node that never adopted (partitioned away, or propagation
+        off) records a rootless round span too — it must NOT overwrite
+        the round's origin/trace even when it lands after the real
+        proposer's span in the ring."""
+        tr = tracing.get_tracer()
+        root = _mk_round(tr, 11, 0, proposer=3, members=[0, 1])
+        # a partitioned node: same (h, r), rootless, NOT the proposer
+        stray = tr.begin("consensus.round", h=11, r=0, node=5)
+        tr.finish(stray, committed=False)
+        rep = tr.rounds_report()
+        g = rep["rounds"][0]
+        assert g["origin"] == 3
+        assert g["trace"] == root.trace_id
+        # the stray still renders as a member, unadopted
+        stray_entry = next(n for n in g["nodes"] if n["node"] == 5)
+        assert stray_entry["adopted"] is False
+        # with propagation off entirely (every node rootless, only the
+        # proposer flagged), origin is still exactly the proposer
+        tr.reset()
+        for node in (0, 1, 2):
+            sp = tr.begin("consensus.round", h=12, r=0, node=node)
+            if node == 1:
+                sp.set(proposer=True)
+            tr.finish(sp, committed=True)
+        g = tr.rounds_report()["rounds"][0]
+        assert g["origin"] == 1
