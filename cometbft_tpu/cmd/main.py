@@ -354,6 +354,29 @@ def cmd_trace(args) -> int:
             "breaker %-12s %-9s opens=%s last_error=%s"
             % (name, br.get("state"), br.get("opens"), br.get("last_error") or "-")
         )
+    dev = doc.get("device", {})
+    if dev and dev.get("up") is not None:
+        print(
+            "device: %s platform=%s probes=%s transitions=%s source=%s"
+            % (
+                "UP" if dev.get("up") else "DOWN",
+                dev.get("platform") or "?",
+                dev.get("probes"),
+                dev.get("transitions"),
+                dev.get("source"),
+            )
+        )
+    bb = doc.get("blackbox", {})
+    if bb and "records" in bb:
+        print(
+            "blackbox: records=%s bytes=%s dropped=%s segments=%s"
+            % (
+                bb.get("records"),
+                bb.get("bytes"),
+                bb.get("dropped"),
+                bb.get("segments"),
+            )
+        )
     sig = doc.get("sigcache", {})
     if sig:
         print(
@@ -433,6 +456,86 @@ def cmd_trace(args) -> int:
                     len(g["nodes"]), committed, g["commits"],
                 )
             )
+    return 0
+
+
+def cmd_postmortem(args) -> int:
+    """Reconstruct a dead node's final timeline from its black-box
+    journal (docs/observability.md "Black box"): last committed height,
+    the in-flight consensus round (step spans, quorum arrivals), open
+    spans at death, the last verify-dispatch attribution triple, recent
+    anomalies and last-known breaker states.  A torn final record is a
+    normal crash artifact; corruption is skipped and counted, never
+    raised.  ``--json`` prints the full report (sort_keys-stable, so two
+    same-seed sim crashes byte-compare)."""
+    from cometbft_tpu.libs import blackbox
+
+    target = blackbox.resolve_dir(args.dir)
+    if target is None:
+        print(f"no black-box journal under {args.dir}", file=sys.stderr)
+        return 1
+    report = blackbox.postmortem_report(target, recent=args.recent)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    j = report["journal"]
+    print(
+        "journal: %d records in %d segment(s), %d bytes%s%s"
+        % (
+            j["records"],
+            j["segments"],
+            j["bytes"],
+            ", %d corrupt skipped" % j["corrupt_skipped"]
+            if j["corrupt_skipped"]
+            else "",
+            ", torn tail" if j["torn_tail"] else "",
+        )
+    )
+    print(
+        "shutdown: %s"
+        % ("CLEAN" if report["clean_close"] else "UNCLEAN (no sentinel)")
+    )
+    print("last committed height: %s" % report["last_committed_height"])
+    inf = report["in_flight"]
+    if inf:
+        print(
+            "in-flight round at death: h=%s r=%s node=%s (opened t=%s)"
+            % (inf["h"], inf["r"], inf["node"], inf["t0"])
+        )
+        for step, dur in sorted(inf["steps"].items()):
+            print("  step %-24s %s ms" % (step, dur))
+        for k, ms in sorted(inf["quorum"].items()):
+            print("  quorum %-22s %s ms" % (k, ms))
+    else:
+        print("in-flight round at death: none recorded")
+    ld = report["last_dispatch"]
+    if ld:
+        print(
+            "last dispatch: tier=%s lanes=%s n=%s ordinal=%s"
+            % (ld["tier"], ld["lanes"], ld["n"], ld["dispatch"])
+        )
+    for sp in report["open_spans"]:
+        print(
+            "open span at death: %s (span=%s t0=%s) %s"
+            % (sp["stage"], sp["span"], sp["t0"], sp["attrs"])
+        )
+    for kind, n in sorted(report["anomaly_counts"].items()):
+        print("anomaly %s: %d" % (kind, n))
+    for backend, st in sorted(report["breakers"].items()):
+        print(
+            "breaker %-12s %-7s%s"
+            % (
+                backend,
+                st["state"],
+                " last_error=%s" % st["error"] if st.get("error") else "",
+            )
+        )
+    for ev in report["device_events"]:
+        a = ev.get("attrs") or {}
+        print(
+            "device probe t=%s up=%s platform=%s"
+            % (ev.get("t"), a.get("up"), a.get("platform"))
+        )
     return 0
 
 
@@ -834,6 +937,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sp.add_argument("--json", action="store_true", help="raw JSON document")
     sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser(
+        "postmortem",
+        help="reconstruct a dead node's final timeline from its black-box "
+        "journal (docs/observability.md)",
+    )
+    sp.add_argument(
+        "dir",
+        help="journal directory (or a node home / data dir containing one)",
+    )
+    sp.add_argument(
+        "--recent", type=int, default=16,
+        help="recent anomalies/events to include (default 16)",
+    )
+    sp.add_argument(
+        "--json", action="store_true",
+        help="full report as sort_keys-stable JSON",
+    )
+    sp.set_defaults(fn=cmd_postmortem)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

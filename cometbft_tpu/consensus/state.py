@@ -411,7 +411,19 @@ class ConsensusState(BaseService):
         if sp is None or key in sp.attrs or sp.attrs.get("r") != round_:
             return
         t = tracing.get_tracer().time() - sp.t_start
-        sp.set(**{key: round(t * 1e3, 6)})
+        ms = round(t * 1e3, 6)
+        sp.set(**{key: ms})
+        # quorum arrivals are stamped onto the UNFINISHED anchor, which a
+        # crash would lose — journal them so the black box can attach them
+        # to the in-flight round's postmortem (no-op without a journal)
+        tracing.note_event(
+            "quorum",
+            h=sp.attrs.get("h"),
+            r=sp.attrs.get("r"),
+            node=sp.attrs.get("node"),
+            key=key,
+            ms=ms,
+        )
 
     def current_trace_ctx(self):
         """The trace context outgoing gossip should carry, or None.  Only

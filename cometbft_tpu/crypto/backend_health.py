@@ -177,6 +177,18 @@ class CircuitBreaker:
             self._successes_total += 1
             if repromoted:
                 self._repromotions += 1
+        if repromoted:
+            # the close half of the breaker's state transitions: opens are
+            # journaled through the anomaly path; re-promotions are not
+            # anomalies, so they go straight to the black box (no-op
+            # without a journal) — outside the breaker lock, like the open
+            from cometbft_tpu.libs import tracing
+
+            tracing.note_event(
+                "breaker_close",
+                backend=self.name,
+                repromotions=self._repromotions,
+            )
 
     def record_failure(self, err: Optional[BaseException] = None) -> None:
         opened = False

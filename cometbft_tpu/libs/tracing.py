@@ -108,6 +108,48 @@ def enabled() -> bool:
     return os.environ.get("COMETBFT_TPU_TRACE", "1") != "0"
 
 
+# -- durable sinks (libs/blackbox.py) -----------------------------------------
+#
+# The black-box journal subscribes here; with no sink installed (the
+# default, and always under COMETBFT_TPU_BLACKBOX=0) every hook is a
+# single None check — the RAM-only recorder is bit-for-bit unchanged.
+#   span(sp)              — every COMPLETED span, as it lands in the ring
+#   open(sp)              — every explicit begin() span (round anchors)
+#   anomaly(kind, attrs, t) — EVERY anomaly occurrence (the RAM dump
+#                           latch stays first-per-kind; the journal does not)
+#   event(kind, attrs)    — low-rate journal-only events (breaker
+#                           transitions, quorum arrivals, device probes)
+
+_SINKS: dict = {"span": None, "open": None, "anomaly": None, "event": None}
+
+
+def set_sink(kind: str, fn):
+    """Install (or, with None, remove) a durable sink; returns the sink
+    it replaced so callers can restore it.  Sink errors are swallowed at
+    the call sites — forensics must never become a second failure."""
+    prev = _SINKS[kind]
+    _SINKS[kind] = fn
+    return prev
+
+
+def get_sink(kind: str):
+    return _SINKS[kind]
+
+
+def note_event(kind: str, **attrs) -> None:
+    """Journal-only event: recorded by the black box when one is
+    installed, invisible to the RAM ring.  For low-rate state transitions
+    (breaker close, device probe flips, quorum arrivals on the in-flight
+    round) whose loss at crash time would blind a postmortem."""
+    fn = _SINKS["event"]
+    if fn is None:
+        return
+    try:
+        fn(kind, attrs)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def trace_dir() -> Optional[str]:
     return os.environ.get("COMETBFT_TPU_TRACE_DIR") or None
 
@@ -406,7 +448,17 @@ class Tracer:
                 attrs.setdefault("xnode", ctx.origin)
         else:
             trace_id, parent_id = sid, None
-        return Span(trace_id, sid, parent_id, stage, self._clock(), attrs)
+        sp = Span(trace_id, sid, parent_id, stage, self._clock(), attrs)
+        sink = _SINKS["open"]
+        if sink is not None:
+            # the journal's OPEN record: an explicit span (a consensus
+            # round anchor) exists from this moment, so a crash before
+            # finish() still leaves the in-flight round reconstructable
+            try:
+                sink(sp)
+            except Exception:  # noqa: BLE001
+                pass
+        return sp
 
     def finish(self, sp: Optional[Span], **attrs) -> None:
         """Stamp the end time and record an explicit span.  Idempotent on
@@ -492,6 +544,14 @@ class Tracer:
             dt = time.perf_counter() - t0
             self._overhead_s += dt
             self._life_overhead_s += dt
+        sink = _SINKS["span"]
+        if sink is not None:
+            # outside the ring lock: the journal enqueue has its own lock
+            # and never blocks on IO (bounded queue, counted drops)
+            try:
+                sink(sp)
+            except Exception:  # noqa: BLE001
+                pass
 
     # -- anomaly forensics -------------------------------------------------
 
@@ -502,6 +562,14 @@ class Tracer:
         None when no dump was written.  Never raises — forensics must not
         become a second failure."""
         dump_all = os.environ.get("COMETBFT_TPU_TRACE_DUMP_ALL") == "1"
+        sink = _SINKS["anomaly"]
+        if sink is not None:
+            # the durable journal records EVERY occurrence (and fsyncs);
+            # the RAM dump below stays latched first-per-kind
+            try:
+                sink(kind, attrs, self._clock())
+            except Exception:  # noqa: BLE001
+                pass
         with self._lock:
             self._anomalies[kind] = self._anomalies.get(kind, 0) + 1
             self._life_anomalies += 1
@@ -772,6 +840,25 @@ class Tracer:
         ``time.perf_counter``."""
         self._clock = clock or time.perf_counter
 
+    def dump_state(self) -> dict:
+        """Snapshot of the anomaly-dump latch (first-per-kind set, dump
+        sequence, dump names).  Scenario setup hooks save this so their
+        teardown can restore it — composed scenarios' setup/teardown must
+        not leak dump-latch state into the run (or each other) any more
+        than they leak env knobs."""
+        with self._lock:
+            return {
+                "dumped_kinds": set(self._dumped_kinds),
+                "dump_seq": self._dump_seq,
+                "dumps": list(self._dumps),
+            }
+
+    def restore_dump_state(self, state: dict) -> None:
+        with self._lock:
+            self._dumped_kinds = set(state.get("dumped_kinds", ()))
+            self._dump_seq = int(state.get("dump_seq", 0))
+            self._dumps = list(state.get("dumps", ()))
+
     def reset(self) -> None:
         """Fresh recorder state: empty ring, zeroed counters/ids, dump
         latch cleared.  The sim calls this per scenario run so span ids
@@ -903,10 +990,22 @@ def trace_document(
 
         return istats.snapshot()
 
+    def _device():
+        from cometbft_tpu.ops import device_health
+
+        return device_health.snapshot()
+
+    def _blackbox():
+        from cometbft_tpu.libs import blackbox
+
+        return blackbox.journal_stats() or {"enabled": blackbox.enabled()}
+
     section("backend", _backend)
     section("sigcache", _sigcache)
     section("dispatch", _dispatch)
     section("sched", _sched)
     section("warmboot", _warmboot)
     section("ingest", _ingest)
+    section("device", _device)
+    section("blackbox", _blackbox)
     return doc

@@ -290,6 +290,12 @@ class Node(BaseService):
                     m.mempool_size_bytes.set(self.mempool.size_bytes())
                 if self.switch is not None:
                     m.peers.set(len(self.switch.peers_list()))
+                # chip availability: fold the out-of-process watcher's
+                # status file into the gauge + journal (no-op unless
+                # COMETBFT_TPU_CHIP_STATUS points at one)
+                from cometbft_tpu.ops import device_health
+
+                device_health.poll_status_file()
             except Exception:  # noqa: BLE001 — metrics must never kill the node
                 pass
             time.sleep(2.0)
@@ -415,6 +421,51 @@ class Node(BaseService):
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
+        # black-box journal (docs/observability.md "Black box"): decode
+        # the PREVIOUS run's journal first — a missing clean-close
+        # sentinel means the process died uncleanly, and the postmortem
+        # digest belongs in the boot log (and at /debug/postmortem)
+        # before anything overwrites the evidence.  COMETBFT_TPU_BLACKBOX=0
+        # restores the RAM-only recorder bit-for-bit.
+        from cometbft_tpu.libs import blackbox
+
+        self.boot_postmortem = None
+        self._blackbox = None
+        if blackbox.enabled():
+            bb_dir = os.path.join(
+                self.config.base.home, self.config.base.db_dir, "blackbox"
+            )
+            try:
+                self.boot_postmortem = blackbox.boot_report(bb_dir)
+            except Exception as e:  # noqa: BLE001 — forensics must never
+                # keep a node from booting
+                self.logger.error("black-box boot decode failed", err=repr(e))
+            if self.boot_postmortem and self.boot_postmortem.get(
+                "unclean_shutdown"
+            ):
+                bp = self.boot_postmortem
+                self.logger.warn(
+                    "unclean shutdown detected: previous run left no "
+                    "clean-close sentinel",
+                    last_committed=bp.get("last_committed_height"),
+                    in_flight=bp.get("in_flight"),
+                    last_dispatch=bp.get("last_dispatch"),
+                    open_spans=len(bp.get("open_spans") or ()),
+                    anomalies=bp.get("anomaly_counts"),
+                    torn_tail=bp.get("journal", {}).get("torn_tail"),
+                )
+            self._blackbox = blackbox.open_journal(bb_dir)
+            if self._blackbox is not None:
+                self._blackbox.on_event(
+                    "boot",
+                    {
+                        "height": self.state.last_block_height,
+                        "unclean_prev": bool(
+                            self.boot_postmortem
+                            and self.boot_postmortem.get("unclean_shutdown")
+                        ),
+                    },
+                )
         # warm-boot the verify compile matrix in the background (docs/
         # warm-boot.md): on the trusted tpu backend the node reaches full
         # verify throughput without its first commits paying a compile.
@@ -520,6 +571,9 @@ class Node(BaseService):
             height=self.state.last_block_height,
             flight_recorder="on" if tracing.enabled() else "off",
             trace_dir=tracing.trace_dir() or "",
+            blackbox=(
+                self._blackbox.dir if self._blackbox is not None else "off"
+            ),
         )
 
     def _run_statesync(self) -> None:
@@ -618,6 +672,16 @@ class Node(BaseService):
             if srv is not None:
                 srv.stop()
         self.proxy_app.stop()
+        if getattr(self, "_blackbox", None) is not None:
+            # the clean-close sentinel: the one record whose absence at
+            # the next boot means this stop never ran
+            from cometbft_tpu.libs import blackbox
+
+            if blackbox.get_journal() is self._blackbox:
+                blackbox.close_journal(clean=True)
+            else:
+                self._blackbox.close(clean=True)
+            self._blackbox = None
         self.db.close()
         self.logger.info("node stopped")
 

@@ -1,0 +1,153 @@
+"""Chip availability as first-class telemetry (VERDICT r5 follow-up).
+
+The axon tunnel to the chip comes and goes, and until now the only record
+of an outage was ``scripts/chip_watch.py``'s ad-hoc ``chipwatch.log`` —
+an entire round of chip unavailability was reconstructable only from grep.
+This module folds probe results into the node's own telemetry:
+
+  * ``record_probe(up, ...)`` — called by in-process probes, or fed from
+    the chip watcher's status file.  Up↔down TRANSITIONS are journaled as
+    black-box ``device_probe`` events (``tracing.note_event``), so an
+    outage window is reconstructable from a dead node's journal.
+  * ``cometbft_device_up`` — a /metrics gauge over ``snapshot()``
+    (1 up, 0 down, -1 never probed).
+  * a ``device`` section in ``tracing.trace_document()`` (the
+    ``/debug/verify_trace`` document and the ``cometbft-tpu trace`` CLI).
+
+The out-of-process watcher (``scripts/chip_watch.py``) writes a small
+status JSON after every probe; a node pointed at it via
+``COMETBFT_TPU_CHIP_STATUS`` picks changes up on its sampler loop
+(``poll_status_file``), so watcher and node never share a process.
+
+Deliberately jax-free, like every forensic surface: reading chip health
+must never be the thing that initializes (or hangs on) the chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+_LOCK = threading.Lock()
+
+
+def _fresh() -> dict:
+    return {
+        "up": None,  # None = never probed
+        "platform": "",
+        "init_s": None,
+        "probes": 0,
+        "transitions": 0,
+        "last_change_t": None,
+        "last_probe_t": None,
+        "source": "",
+        "_file_mtime": 0.0,
+    }
+
+
+_S = _fresh()
+
+
+def record_probe(
+    up: bool,
+    platform: str = "",
+    init_s: Optional[float] = None,
+    source: str = "probe",
+    t: Optional[float] = None,
+) -> bool:
+    """Record one probe result; returns True when the availability state
+    CHANGED (first probe, or an up↔down flip).  Transitions are journaled
+    as black-box ``device_probe`` events — a no-op without a journal."""
+    t = time.time() if t is None else t
+    with _LOCK:
+        prev = _S["up"]
+        changed = prev is None or prev != bool(up)
+        _S["up"] = bool(up)
+        _S["platform"] = platform or _S["platform"]
+        if init_s is not None:
+            _S["init_s"] = init_s
+        _S["probes"] += 1
+        _S["last_probe_t"] = t
+        _S["source"] = source
+        if changed:
+            if prev is not None:
+                _S["transitions"] += 1
+            _S["last_change_t"] = t
+    if changed:
+        from cometbft_tpu.libs import tracing
+
+        tracing.note_event(
+            "device_probe",
+            up=bool(up),
+            platform=platform,
+            source=source,
+        )
+    return changed
+
+
+def status_file() -> Optional[str]:
+    return os.environ.get("COMETBFT_TPU_CHIP_STATUS") or None
+
+
+def poll_status_file(path: Optional[str] = None) -> bool:
+    """Fold the chip watcher's status JSON into the in-process state.
+    Cheap (one stat) when unchanged; tolerant of a missing or torn file
+    (the watcher may be mid-write).  Returns True on a state change."""
+    path = path or status_file()
+    if not path:
+        return False
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return False
+    with _LOCK:
+        prev_mtime = _S["_file_mtime"]
+        if mtime <= prev_mtime:
+            return False
+        _S["_file_mtime"] = mtime
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        # torn or mid-write: roll the consumed mark back so the NEXT poll
+        # retries this update instead of dropping it forever
+        with _LOCK:
+            if _S["_file_mtime"] == mtime:
+                _S["_file_mtime"] = prev_mtime
+        return False
+    return record_probe(
+        up=bool(doc.get("up")),
+        platform=str(doc.get("platform") or ""),
+        init_s=doc.get("init_s"),
+        source="chipwatch",
+        t=doc.get("t"),
+    )
+
+
+def snapshot() -> dict:
+    """The ``device`` section of the forensic document; reads the status
+    file first so a scrape is never staler than the watcher."""
+    poll_status_file()
+    with _LOCK:
+        return {
+            "up": _S["up"],
+            # the gauge encoding: 1 up, 0 down, -1 never probed
+            "up_code": -1 if _S["up"] is None else int(_S["up"]),
+            "platform": _S["platform"],
+            "init_s": _S["init_s"],
+            "probes": _S["probes"],
+            "transitions": _S["transitions"],
+            "last_change_t": _S["last_change_t"],
+            "last_probe_t": _S["last_probe_t"],
+            "source": _S["source"],
+            "status_file": status_file() or "",
+        }
+
+
+def reset() -> None:
+    global _S
+    with _LOCK:
+        _S = _fresh()

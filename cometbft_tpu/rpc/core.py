@@ -716,6 +716,26 @@ class Environment:
         doc["node"] = ctx
         return doc
 
+    def debug_postmortem(self) -> dict:
+        """The node's crash forensics: the previous run's black-box
+        postmortem digest (decoded at boot — ``unclean_shutdown`` means
+        the last process died without writing its clean-close sentinel)
+        plus the live journal's counters.  Served as ``/debug/postmortem``
+        (GET) and the ``debug_postmortem`` JSON-RPC method; jax-free like
+        every forensic surface (docs/observability.md)."""
+        from cometbft_tpu.libs import blackbox
+
+        boot = getattr(self.node, "boot_postmortem", None)
+        doc: dict = {
+            "blackbox": "on" if blackbox.enabled() else "off",
+            "unclean_shutdown": bool(boot and boot.get("unclean_shutdown")),
+            "boot": boot or {},
+        }
+        stats = blackbox.journal_stats()
+        if stats is not None:
+            doc["journal"] = stats
+        return doc
+
     def broadcast_evidence(self, evidence) -> dict:
         """Reference: rpc/core/evidence.go BroadcastEvidence.  ``evidence``
         is the proto-encoded evidence (base64/hex/quoted per _bytes_arg)."""
@@ -771,6 +791,9 @@ ROUTES = {
     # alias serves the conventional GET /debug/verify_trace path
     "debug_verify_trace": "debug_verify_trace",
     "debug/verify_trace": "debug_verify_trace",
+    # black-box crash forensics (boot postmortem digest + live journal)
+    "debug_postmortem": "debug_postmortem",
+    "debug/postmortem": "debug_postmortem",
 }
 
 # Served only when config rpc.unsafe is true (reference AddUnsafeRoutes,

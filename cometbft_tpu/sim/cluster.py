@@ -38,6 +38,7 @@ from cometbft_tpu.consensus.messages import (
     ProposalMessage,
     VoteMessage,
 )
+from cometbft_tpu.libs import blackbox, tracing
 from cometbft_tpu.types.block import commit_vote as _commit_vote
 from cometbft_tpu.sim.clock import SimTicker, VirtualClock
 from cometbft_tpu.sim.invariants import InvariantChecker
@@ -123,6 +124,24 @@ class SimCluster:
         # (invariant checker, scripted actions).
         self.active_node: Optional[int] = None
         self._dbs: list = [None] * self.n_nodes  # MemKV survives crash-restart
+        # per-node black-box journals (docs/observability.md "Black box"):
+        # synchronous mode — one sim thread, so journal bytes are a pure
+        # function of the seed — routed from the process-wide tracer by
+        # ``active_node``.  ``crash`` kills a journal with the same
+        # drop-unflushed-tail discipline as the WAL; ``restart`` decodes
+        # the dead journal's postmortem (digest logged into the
+        # byte-compared trace) before reopening it.
+        self.blackbox: dict = {}
+        self.postmortems: list[dict] = []
+        self._bb_enabled = blackbox.enabled()
+        self._bb_prev_sinks: Optional[dict] = None
+        if self._bb_enabled:
+            self._bb_prev_sinks = {
+                "span": tracing.set_sink("span", self._bb_span),
+                "open": tracing.set_sink("open", self._bb_open),
+                "anomaly": tracing.set_sink("anomaly", self._bb_anomaly),
+                "event": tracing.set_sink("event", self._bb_event),
+            }
         self.nodes: list[Optional[NodeHandle]] = [
             self._build(i) for i in range(n_vals)
         ] + [None] * n_spares
@@ -161,7 +180,106 @@ class SimCluster:
         # is read at send time — the anchor may have been adopted since
         node.cs.trace_origin = i
         node.cs.broadcast_hook = lambda msg, i=i: self._broadcast(i, msg)
+        if self._bb_enabled:
+            j = blackbox.BlackboxJournal(
+                str(self.root / f"node{i}" / "blackbox"),
+                threaded=False,  # the one sim thread writes; deterministic
+                clock=self.clock.now,
+                # no periodic health records in sim: their counter
+                # snapshots carry WALL-clock aggregates (verify_seconds,
+                # latency sums), which would break the journal's
+                # byte-per-seed determinism the soak matrix enforces
+                health_every=None,
+            )
+            j.on_event("boot", {"node": i})
+            self.blackbox[i] = j
         return node
+
+    # -- black-box routing -------------------------------------------------
+    #
+    # One process hosts every sim node but each node keeps its OWN
+    # journal, like production: records route by ``active_node`` (set
+    # while a node's work executes), falling back to the span's ``node``
+    # attr for consensus records emitted outside a drain.  Cluster-level
+    # work (the invariant checker) is nobody's black box and is dropped.
+
+    def _bb_target(self, attrs=None):
+        i = self.active_node
+        if i is None and attrs:
+            i = attrs.get("node")
+        if i is None:
+            return None
+        j = self.blackbox.get(i)
+        return j if j is not None and not j.closed else None
+
+    def _bb_span(self, sp) -> None:
+        j = self._bb_target(sp.attrs)
+        if j is not None:
+            j.on_span(sp)
+
+    def _bb_open(self, sp) -> None:
+        j = self._bb_target(sp.attrs)
+        if j is not None:
+            j.on_open(sp)
+
+    def _bb_anomaly(self, kind, attrs, t) -> None:
+        j = self._bb_target(attrs)
+        if j is not None:
+            j.on_anomaly(kind, attrs, t)
+
+    def _bb_event(self, kind, attrs) -> None:
+        j = self._bb_target(attrs)
+        if j is not None:
+            j.on_event(kind, attrs)
+
+    def _bb_close_all(self, clean: bool = True) -> None:
+        for j in self.blackbox.values():
+            if not j.closed:
+                j.close(clean=clean)
+        if self._bb_prev_sinks is not None:
+            ours = (
+                self._bb_span,
+                self._bb_open,
+                self._bb_anomaly,
+                self._bb_event,
+            )
+            for k, fn in self._bb_prev_sinks.items():
+                # restore only sinks still ours — a later installer
+                # (another cluster, a node) must not be clobbered
+                if tracing.get_sink(k) in ours:
+                    tracing.set_sink(k, fn)
+            self._bb_prev_sinks = None
+
+    def blackbox_stats(self) -> dict:
+        """Aggregate journal counters for soak rows: total records/bytes/
+        drops/rotations across the cluster, on-disk footprint vs the
+        configured segment budget (``budget_ok`` fails a soak row that
+        outgrew it), and the postmortem count."""
+        import os as _os
+
+        agg = {"records": 0, "bytes": 0, "dropped": 0, "rotations": 0}
+        disk = 0
+        budget_ok = True
+        for j in self.blackbox.values():
+            s = j.stats()
+            for k in agg:
+                agg[k] += s[k]
+            node_disk = 0
+            for fp in blackbox.segment_files(j.dir):
+                try:
+                    node_disk += _os.path.getsize(fp)
+                except OSError:
+                    pass
+            disk += node_disk
+            # + one frame of slack: rotation triggers on the write that
+            # would cross the threshold
+            if node_disk > j.segments * j.segment_bytes + blackbox.MAX_REC_SIZE:
+                budget_ok = False
+        agg["disk_bytes"] = disk
+        agg["budget_ok"] = budget_ok
+        agg["nodes"] = len(self.blackbox)
+        agg["postmortems"] = len(self.postmortems)
+        return agg
 
     def _broadcast(self, i: int, msg) -> None:
         node = self.nodes[i]
@@ -183,17 +301,27 @@ class SimCluster:
         self._started = True
         for node in self.live_nodes():
             self._log("start node%d" % node.index)
-            node.cs.start()
+            self._start_cs(node)
         if self._catchup:
             self.clock.call_later(
                 CATCHUP_INTERVAL, self._catchup_tick, label="catchup"
             )
         self._drain_all()
 
+    def _start_cs(self, node: NodeHandle) -> None:
+        """Start a node's consensus with ``active_node`` set, so the
+        round anchor its start opens routes to the node's own journal."""
+        self.active_node = node.index
+        try:
+            node.cs.start()
+        finally:
+            self.active_node = None
+
     def stop(self) -> None:
         for node in self.live_nodes():
             node.cs.stop()
             node.app_conns.stop()
+        self._bb_close_all(clean=True)
 
     def crash(self, i: int) -> None:
         """Kill node i: its process state vanishes, its stores/WAL/privval
@@ -207,6 +335,12 @@ class SimCluster:
         self.nodes[i] = None  # alive_fn now reports dead
         if node.cs.wal is not None:
             node.cs.wal.kill()
+        j = self.blackbox.get(i)
+        if j is not None:
+            # same discipline as the WAL: the journal's unflushed tail
+            # dies with the process, so crash scenarios exercise real
+            # torn tails — a graceful close here would hide them
+            j.kill()
         node.cs.stop()
         node.app_conns.stop()
 
@@ -216,9 +350,37 @@ class SimCluster:
         if self.nodes[i] is not None:
             return
         self._log("restart node%d" % i)
+        if self._bb_enabled:
+            # decode the dead journal BEFORE reopening repairs its torn
+            # tail — the same order a real node boots in.  The digest
+            # lands in the byte-compared trace, so the nightly matrix's
+            # same-seed double runs enforce that a killed node's
+            # reconstruction is a pure function of the seed.
+            rep = blackbox.postmortem_report(
+                str(self.root / f"node{i}" / "blackbox")
+            )
+            self.postmortems.append(
+                {"node": i, "t": round(self.clock.now(), 6), "report": rep}
+            )
+            inf = rep.get("in_flight") or {}
+            self._log(
+                "restart node%d postmortem: clean=%s records=%d torn=%s "
+                "corrupt=%d last_committed=%s inflight=h%s/r%s open_spans=%d"
+                % (
+                    i,
+                    rep["clean_close"],
+                    rep["journal"]["records"],
+                    rep["journal"]["torn_tail"],
+                    rep["journal"]["corrupt_skipped"],
+                    rep["last_committed_height"],
+                    inf.get("h"),
+                    inf.get("r"),
+                    len(rep["open_spans"]),
+                )
+            )
         node = self._build(i)
         self.nodes[i] = node
-        node.cs.start()
+        self._start_cs(node)
         self._drain_all()
         self.checker.on_restart(self, i)
 
@@ -236,6 +398,9 @@ class SimCluster:
         self.nodes[i] = None
         node.cs.stop()
         node.app_conns.stop()
+        j = self.blackbox.get(i)
+        if j is not None:
+            j.close(clean=True)  # graceful: the sentinel IS the point
         self.members.discard(i)
 
     def spawn_spare(self, i: int) -> None:
@@ -248,7 +413,7 @@ class SimCluster:
         node = self._build(i)
         self.nodes[i] = node
         self.members.add(i)
-        node.cs.start()
+        self._start_cs(node)
         self._drain_all()
 
     def join(self, i: int, helper_index: Optional[int] = None) -> bool:
@@ -297,7 +462,11 @@ class SimCluster:
         from cometbft_tpu.store.block_store import BlockStore
         from cometbft_tpu.store.kv import MemKV
 
-        # fresh machine: no stores, no WAL, no privval history
+        # fresh machine: no stores, no WAL, no privval history — and no
+        # black box (close the old handle before its dir vanishes)
+        j = self.blackbox.pop(i, None)
+        if j is not None and not j.closed:
+            j.close(clean=False)
         shutil.rmtree(self.root / f"node{i}", ignore_errors=True)
         self._dbs[i] = None
         app = (
@@ -398,7 +567,7 @@ class SimCluster:
         self._log(
             "join node%d statesync complete h=%d" % (i, state.last_block_height)
         )
-        node.cs.start()
+        self._start_cs(node)
         self._drain_all()
         return True
 

@@ -134,6 +134,11 @@ class ScenarioResult:
     # dump files (name + sha256 — hashed BEFORE the run root is deleted,
     # so determinism tests byte-compare dumps across same-seed runs)
     spans: dict = field(default_factory=dict)
+    # black-box journal counters (records/bytes/drops/rotations summed
+    # over the cluster) plus the restart-time postmortem reports of every
+    # crashed node, captured before the run root is deleted
+    blackbox: dict = field(default_factory=dict)
+    postmortems: list = field(default_factory=list)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -179,6 +184,8 @@ class ScenarioResult:
             row["evidence"] = dict(self.evidence)
         if self.rotations:
             row["rotations"] = self.rotations
+        if self.blackbox:
+            row["blackbox"] = dict(self.blackbox)
         if self.spans:
             row["spans"] = {
                 "recorded": self.spans.get("recorded", 0),
@@ -288,6 +295,15 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_TXINGEST_QUEUE",
     "COMETBFT_TPU_TXINGEST_BATCH",
     "COMETBFT_TPU_TXINGEST_FLUSH_US",
+    # observability knobs: saved/restored for cross-run hygiene only.
+    # NOTE the cluster reads the BLACKBOX knobs at construction — before
+    # setup hooks run — so a scenario override affects only journals
+    # built AFTER setup (restart/spawn); flip these via the test/CLI
+    # environment, not extra_env, to change a whole run's journaling
+    "COMETBFT_TPU_TRACE_DUMP_ALL",
+    "COMETBFT_TPU_BLACKBOX",
+    "COMETBFT_TPU_BLACKBOX_SEGMENTS",
+    "COMETBFT_TPU_BLACKBOX_SEGMENT_BYTES",
 )
 
 
@@ -317,9 +333,16 @@ def _backend_faults_setup(extra_env: Optional[dict] = None):
     def setup(cluster: SimCluster) -> None:
         from cometbft_tpu.crypto import backend_health
         from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.libs import tracing as _tracing
 
         saved_env = {k: os.environ.get(k) for k in _BACKEND_ENV_KNOBS}
         cluster._backend_saved = (saved_env, cbatch._DEFAULT_BACKEND)
+        # the anomaly-dump latch (first-per-kind set + dump seq) is
+        # process-global state exactly like the env knobs: setup hooks may
+        # trip anomalies (warmup traffic, breaker pokes) and composed
+        # scenarios run several setup/teardown pairs, so the latch rides
+        # the same save/restore — teardown puts it back below
+        cluster._dump_saved = _tracing.get_tracer().dump_state()
         # device path even on CPU hosts: the XLA kernel is verdict-equal to
         # the host reference, and that equality is what degradation relies on
         os.environ["COMETBFT_TPU_CRYPTO_BACKEND"] = "tpu"
@@ -390,6 +413,12 @@ def _backend_faults_teardown(cluster: SimCluster) -> None:
     cbatch.set_default_backend(saved_backend)
     backend_health.registry().set_clock(_wall.monotonic)
     backend_health.reset()
+    dump_saved = getattr(cluster, "_dump_saved", None)
+    if dump_saved is not None:
+        from cometbft_tpu.libs import tracing as _tracing
+
+        _tracing.get_tracer().restore_dump_state(dump_saved)
+        cluster._dump_saved = None
 
 
 def _victims(n_vals: int) -> list[int]:
@@ -1350,6 +1379,8 @@ def run_scenario(
     ingest_counters: dict = {}
     evidence_counters: dict = {}
     spans_capture: dict = {}
+    blackbox_capture: dict = {}
+    postmortem_capture: list = []
     # per-run evidence counters: the process-wide stats must not bleed one
     # run's flood into the next run's ScenarioResult
     from cometbft_tpu.evidence import stats as _evstats
@@ -1372,6 +1403,15 @@ def run_scenario(
     from cometbft_tpu.ops import dispatch_stats as _dstats
 
     _dstats.reset()
+    # journal HEALTH records snapshot the sched/ingest counters, so those
+    # must be per-run too or the black-box bytes of two same-seed runs in
+    # one process would differ (the backend scenarios already reset them
+    # in setup; plain scenarios need the same hygiene)
+    from cometbft_tpu.txingest import stats as _istats
+    from cometbft_tpu.verifysched import stats as _sstats
+
+    _sstats.reset()
+    _istats.reset()
     try:
         if scenario.setup is not None:
             scenario.setup(cluster)
@@ -1435,6 +1475,13 @@ def run_scenario(
                     "sha256": _hashlib.sha256(blob).hexdigest(),
                 }
             )
+        # black-box capture — journal counters + crashed nodes' restart
+        # postmortems, read NOW, before the run root (and the journal
+        # files under it) are deleted below
+        blackbox_capture = (
+            cluster.blackbox_stats() if cluster.blackbox else {}
+        )
+        postmortem_capture = list(cluster.postmortems)
         spans_capture = {
             "recorded": tsnap["spans_recorded"],
             "dropped": tsnap["spans_dropped"],
@@ -1480,4 +1527,6 @@ def run_scenario(
         evidence=evidence_counters,
         rotations=cluster.checker.rotations_seen,
         spans=spans_capture,
+        blackbox=blackbox_capture,
+        postmortems=postmortem_capture,
     )

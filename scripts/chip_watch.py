@@ -35,6 +35,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "chipwatch.log")
 ARTIFACT = os.path.join(REPO, "BENCH_CHIPWATCH.json")
+# machine-readable availability status: nodes pointed here via
+# COMETBFT_TPU_CHIP_STATUS fold it into the cometbft_device_up gauge and
+# journal up<->down transitions as black-box device_probe events
+# (cometbft_tpu/ops/device_health.py), so an outage like VERDICT r5's is
+# a gauge flip and a journal record — not a grep of this log
+STATUS = os.path.join(REPO, "chipwatch_status.json")
 
 
 def log(msg: str) -> None:
@@ -42,6 +48,24 @@ def log(msg: str) -> None:
     print(line, flush=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
+
+
+def write_status(rec: "dict | None") -> None:
+    """Atomic status-file update after every probe (torn reads are still
+    tolerated on the consumer side)."""
+    doc = {
+        "t": time.time(),
+        "up": rec is not None,
+        "platform": rec.get("platform") if rec else None,
+        "init_s": rec.get("init_s") if rec else None,
+    }
+    tmp = STATUS + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, STATUS)
+    except OSError as e:
+        log("status write failed: %r" % e)
 
 
 def probe(timeout_s: float = 120.0) -> dict | None:
@@ -102,6 +126,7 @@ def main() -> None:
     log("chip_watch started (interval=%gs)" % args.interval)
     while True:
         rec = probe()
+        write_status(rec)
         if rec is None:
             log("probe: no answer")
         else:

@@ -17,6 +17,16 @@ the warm boot exists to preserve.  The TTL grace (default 7 days,
 COMETBFT_TPU_EXEC_CACHE_TTL_DAYS) protects entries belonging to OTHER
 live configurations (a different XLA_FLAGS topology, a flipped trace env
 var) that simply haven't been rewritten recently.
+
+``--blackbox DIR`` switches to black-box journal GC instead
+(docs/observability.md "Black box"): every journal found under DIR (a
+node home, a fleet's data root, a sim scratch tree) keeps its newest
+``--segments`` segments (default COMETBFT_TPU_BLACKBOX_SEGMENTS) and —
+with --ttl-days — loses rolled segments older than the TTL.  Head
+segments are never removed: the newest forensics survive any prune.
+
+    python scripts/exec_cache_gc.py --blackbox /var/cometbft  [--dry-run]
+    python scripts/exec_cache_gc.py --blackbox . --segments 2 --ttl-days 3
 """
 
 from __future__ import annotations
@@ -52,7 +62,36 @@ def main() -> int:
     ap.add_argument(
         "--dry-run", action="store_true", help="report, remove nothing"
     )
+    ap.add_argument(
+        "--blackbox",
+        default=None,
+        metavar="DIR",
+        help="prune black-box journals under DIR instead of the exec cache",
+    )
+    ap.add_argument(
+        "--segments",
+        type=int,
+        default=None,
+        help="segments to keep per journal in --blackbox mode "
+        "(default: COMETBFT_TPU_BLACKBOX_SEGMENTS or 4)",
+    )
     args = ap.parse_args()
+
+    if args.blackbox is not None:
+        from cometbft_tpu.libs import blackbox
+
+        removed, freed = blackbox.gc_dir(
+            args.blackbox,
+            max_segments=args.segments,
+            ttl_days=args.ttl_days,
+            dry_run=args.dry_run,
+        )
+        verb = "would remove" if args.dry_run else "removed"
+        print(
+            f"blackbox-gc: {args.blackbox}: {verb} {removed} rolled "
+            f"segment(s), {freed / 1e6:.2f} MB"
+        )
+        return 0
 
     if args.dir:
         os.environ["COMETBFT_TPU_EXEC_CACHE"] = args.dir

@@ -81,6 +81,18 @@ def _row_extra(row: dict) -> str:
         )
     if row.get("rotations"):
         extra += " rot=%d" % row["rotations"]
+    bb = row.get("blackbox") or {}
+    if bb:
+        # black-box journal shape of the run: bytes on disk and (above
+        # all) drops — a nonzero drop count means the bounded queue shed
+        # forensics under load, which a reviewer wants to see in the row
+        extra += " bb=%dB/%drec drop=%d" % (
+            bb.get("bytes", 0),
+            bb.get("records", 0),
+            bb.get("dropped", 0),
+        )
+        if bb.get("postmortems"):
+            extra += " pm=%d" % bb["postmortems"]
     spans = row.get("spans") or {}
     if spans:
         # flight-recorder shape of the run: span volume, anomaly kinds and
@@ -204,6 +216,9 @@ def main() -> int:
             row["reached"]
             and row["invariants_ok"]
             and row.get("trace_identical", True)
+            # a journal that outgrew its configured segment budget is a
+            # black-box regression, failed like any other invariant
+            and (row.get("blackbox") or {}).get("budget_ok", True)
         )
         tag = "ok  " if ok else "FAIL"
         if not row.get("trace_identical", True):

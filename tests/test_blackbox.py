@@ -1,0 +1,784 @@
+"""Crash-persistent black box (ISSUE 12, libs/blackbox +
+docs/observability.md "Black box"): framing + rotation budget, torn-tail /
+corruption decode hardening, drop-counting queue, kill discipline,
+postmortem reconstruction, cross-process decode, and the sim's SIGKILL
+forensics determinism."""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import zlib
+
+import pytest
+
+from cometbft_tpu.libs import blackbox, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    monkeypatch.delenv("COMETBFT_TPU_TRACE", raising=False)
+    monkeypatch.delenv("COMETBFT_TPU_BLACKBOX", raising=False)
+    monkeypatch.delenv("COMETBFT_TPU_BLACKBOX_SEGMENTS", raising=False)
+    monkeypatch.delenv("COMETBFT_TPU_BLACKBOX_SEGMENT_BYTES", raising=False)
+    tracing.reset_tracer()
+    yield
+    blackbox.close_journal(clean=False)
+    for kind in ("span", "open", "anomaly", "event"):
+        tracing.set_sink(kind, None)
+    tracing.reset_tracer()
+
+
+def _mkjournal(tmp_path, **kw):
+    kw.setdefault("threaded", False)
+    kw.setdefault("clock", lambda: 1.0)
+    return blackbox.BlackboxJournal(str(tmp_path / "bb"), **kw)
+
+
+def _fill(j, n, stage="verify.batch", start=0):
+    for i in range(start, start + n):
+        j.append(blackbox.REC_SPAN, {"stage": stage, "span": i, "t0": i * 0.5})
+
+
+class TestFraming:
+    def test_roundtrip_and_clean_close(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        _fill(j, 10)
+        j.append(
+            blackbox.REC_ANOMALY,
+            {"kind": "watchdog_fire", "t": 3.0, "attrs": {"tier": "xla"}},
+            sync=j.SYNC_FSYNC,
+        )
+        j.close(clean=True)
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["records"] == 12
+        assert stats["corrupt_skipped"] == 0 and not stats["torn_tail"]
+        kinds = [k for k, _ in recs]
+        assert kinds[-1] == blackbox.REC_CLEAN_CLOSE
+        assert kinds.count(blackbox.REC_ANOMALY) == 1
+        assert recs[0][1]["stage"] == "verify.batch"
+
+    def test_rotation_respects_segment_budget(self, tmp_path):
+        j = _mkjournal(tmp_path, segment_bytes=2048, segments=3)
+        _fill(j, 2000)
+        j.close(clean=True)
+        files = blackbox.segment_files(j.dir)
+        assert len(files) <= 3
+        total = sum(os.path.getsize(f) for f in files)
+        # the budget: segments * segment_bytes (+ one frame of slack)
+        assert total <= 3 * 2048 + 128
+        assert j.rotations > 0
+
+    def test_records_never_straddle_a_rotation_boundary(self, tmp_path):
+        """Every segment decodes standalone: rotation happens between
+        records, so a pruned (or torn-away) neighbor can never corrupt a
+        surviving segment."""
+        j = _mkjournal(tmp_path, segment_bytes=1024, segments=8)
+        _fill(j, 200)
+        j.close(clean=True)
+        files = blackbox.segment_files(j.dir)
+        assert len(files) > 2
+        for fp in files:
+            data = open(fp, "rb").read()
+            stats = {"corrupt_skipped": 0, "torn_tail": False}
+            recs = list(blackbox._iter_file(data, True, stats))
+            assert recs, fp
+            assert stats["corrupt_skipped"] == 0
+            assert not stats["torn_tail"]
+
+    def test_pruned_oldest_segments_decode_in_order(self, tmp_path):
+        j = _mkjournal(tmp_path, segment_bytes=1024, segments=2)
+        _fill(j, 500)
+        j.close(clean=True)
+        recs, stats = blackbox.decode_dir(j.dir)
+        spans = [p["span"] for k, p in recs if k == blackbox.REC_SPAN]
+        # oldest rotated away; the surviving window is the NEWEST records,
+        # contiguous and ordered — index reuse after pruning would instead
+        # keep a stale early segment and discard every newly rolled one
+        assert spans == list(range(spans[0], 500))
+        assert spans[0] > 0
+
+    def test_rotation_indexes_stay_monotonic_past_pruning(self, tmp_path):
+        """Many rotations past the prune point: the kept window must
+        always be the newest segments (monotonic indexes), never a stale
+        early segment that a reused low index would sort as oldest."""
+        j = _mkjournal(tmp_path, segment_bytes=512, segments=3)
+        _fill(j, 1500)
+        j.close(clean=True)
+        assert j.rotations > 10
+        recs, _stats = blackbox.decode_dir(j.dir)
+        spans = [p["span"] for k, p in recs if k == blackbox.REC_SPAN]
+        assert spans[-1] == 1499
+        assert spans == list(range(spans[0], 1500))
+
+
+class TestDecodeHardening:
+    def test_torn_final_record_is_a_normal_crash_artifact(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        _fill(j, 20)
+        j.close(clean=False)
+        path = j.head_path
+        size = os.path.getsize(path)
+        os.truncate(path, size - 7)  # cut into the last frame
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["torn_tail"] is True
+        assert stats["corrupt_skipped"] == 0
+        assert stats["records"] == 19
+        rep = blackbox.postmortem_report(j.dir)  # never raises
+        assert rep["journal"]["torn_tail"] is True
+        assert rep["unclean_shutdown"] is True
+
+    def test_midstream_crc_corruption_skips_and_counts(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        _fill(j, 30)
+        j.close(clean=True)
+        path = j.head_path
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # payload bit-flip mid-stream
+        open(path, "wb").write(bytes(blob))
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["corrupt_skipped"] == 1
+        assert stats["records"] == 30  # 31 written, 1 skipped
+        assert not stats["torn_tail"]
+        # the postmortem boundary never sees an exception
+        rep = blackbox.postmortem_report(j.dir)
+        assert rep["journal"]["corrupt_skipped"] == 1
+        assert rep["clean_close"] is True
+
+    def test_corrupted_length_field_resyncs(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        _fill(j, 30)
+        j.close(clean=True)
+        path = j.head_path
+        blob = bytearray(open(path, "rb").read())
+        # stomp a frame's LENGTH field (bytes 4..8 of a frame header)
+        # with an implausible value: the decoder must resync forward
+        off = 0
+        for _ in range(10):  # seek to the 11th frame's header
+            _, length = struct.unpack_from(">II", blob, off)
+            off += 8 + length
+        struct.pack_into(">I", blob, off + 4, 0xFFFFFF)
+        open(path, "wb").write(bytes(blob))
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["corrupt_skipped"] >= 1
+        # everything after the resync point still decodes
+        spans = [p["span"] for k, p in recs if k == blackbox.REC_SPAN]
+        assert spans[-1] == 29
+        assert len(spans) >= 28
+
+    def test_reopen_preserves_valid_frames_past_midstream_corruption(
+        self, tmp_path
+    ):
+        """Repair-on-reopen must only cut the torn TAIL: mid-stream
+        corruption followed by valid frames is evidence the decoder can
+        skip-and-count, and a reboot must not destroy it."""
+        j = _mkjournal(tmp_path, flush_every=1)
+        _fill(j, 20)
+        j.close(clean=False)
+        path = j.head_path
+        blob = bytearray(open(path, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF  # corrupt a frame mid-stream
+        blob += b"\x00\x01\x02"       # plus a torn tail
+        open(path, "wb").write(bytes(blob))
+        j2 = _mkjournal(tmp_path, clock=lambda: 9.0, flush_every=1)
+        _fill(j2, 2, start=100)
+        j2.close(clean=True)
+        recs, stats = blackbox.decode_dir(j2.dir)
+        assert stats["corrupt_skipped"] == 1  # the evidence survived
+        assert not stats["torn_tail"]  # the tail alone was repaired away
+        spans = [p["span"] for k, p in recs if k == blackbox.REC_SPAN]
+        assert spans[-2:] == [100, 101]
+        assert len(spans) == 21  # 19 of 20 originals + the 2 appended
+
+    def test_oversized_record_dropped_at_append(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        _fill(j, 3)
+        j.append(
+            blackbox.REC_EVENT,
+            {"kind": "huge", "t": 1.0, "attrs": {"blob": "y" * (2 << 20)}},
+        )
+        _fill(j, 2, start=10)
+        j.close(clean=True)
+        assert j.stats()["dropped"] == 1
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["corrupt_skipped"] == 0  # nothing undecodable landed
+        assert stats["records"] == 6
+
+    def test_corruption_in_rolled_segment_is_not_a_torn_tail(self, tmp_path):
+        j = _mkjournal(tmp_path, segment_bytes=1024, segments=8)
+        _fill(j, 200)
+        j.close(clean=True)
+        rolled = blackbox.segment_files(j.dir)[0]
+        blob = bytearray(open(rolled, "rb").read())
+        blob = blob[: len(blob) - 5]  # truncate a NON-final segment
+        open(rolled, "wb").write(bytes(blob))
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["corrupt_skipped"] == 1
+        assert not stats["torn_tail"]  # torn tails are a last-segment thing
+
+
+class TestQueueAndKill:
+    def test_bounded_queue_drops_are_counted_never_blocking(self, tmp_path):
+        j = blackbox.BlackboxJournal(
+            str(tmp_path / "bb"), threaded=True, queue_max=16,
+            clock=lambda: 1.0,
+        )
+        # stall the writer on the IO lock so the queue must fill
+        with j._iolock:
+            for i in range(200):
+                j.append(blackbox.REC_SPAN, {"stage": "s", "span": i})
+            stalled = j.stats()
+        assert stalled["dropped"] >= 200 - 16 - j.queue_max - 1
+        assert stalled["dropped"] > 0
+        j.close(clean=True)
+        recs, stats = blackbox.decode_dir(j.dir)
+        # everything admitted (not dropped) landed, plus the sentinel
+        assert stats["records"] == 200 - j.stats()["dropped"] + 1
+        assert recs[-1][0] == blackbox.REC_CLEAN_CLOSE
+
+    def test_threaded_anomaly_is_durable_before_append_returns(
+        self, tmp_path
+    ):
+        """The fsync promise in THREADED mode: a SIGKILL right after
+        record_anomaly must still find the anomaly (and everything queued
+        before it) on disk — the caller drains through its own record."""
+        j = blackbox.BlackboxJournal(
+            str(tmp_path / "bb"), threaded=True, clock=lambda: 1.0,
+            flush_every=10**9,
+        )
+        _fill(j, 30)
+        j.on_anomaly("watchdog_fire", {"tier": "xla"}, 2.0)
+        j.kill()  # immediately: no grace for the writer thread
+        recs, stats = blackbox.decode_dir(j.dir)
+        kinds = [k for k, _ in recs]
+        assert blackbox.REC_ANOMALY in kinds
+        assert stats["records"] == 31  # the 30 earlier spans rode along
+
+    def test_kill_drops_unflushed_tail_keeps_fsynced_anomaly(self, tmp_path):
+        j = _mkjournal(tmp_path, flush_every=10**9)
+        _fill(j, 50)
+        j.append(
+            blackbox.REC_ANOMALY,
+            {"kind": "breaker_open", "t": 2.0, "attrs": {"backend": "xla"}},
+            sync=j.SYNC_FSYNC,
+        )
+        _fill(j, 40, start=50)  # unflushed tail: must die with the process
+        j.kill()
+        recs, stats = blackbox.decode_dir(j.dir)
+        kinds = [k for k, _ in recs]
+        assert blackbox.REC_ANOMALY in kinds
+        # the fsync'd anomaly is the last surviving record: the 40-span
+        # tail sat in the user-space buffer and the kill discipline cut it
+        assert kinds[-1] == blackbox.REC_ANOMALY
+        assert stats["records"] == 51
+        rep = blackbox.postmortem_report(j.dir)
+        assert rep["unclean_shutdown"] is True
+        assert rep["anomaly_counts"] == {"breaker_open": 1}
+        assert rep["breakers"]["xla"]["state"] == "open"
+
+    def test_kill_then_reopen_repairs_and_appends(self, tmp_path):
+        j = _mkjournal(tmp_path, flush_every=1)
+        _fill(j, 10)
+        j.close(clean=False)
+        os.truncate(j.head_path, os.path.getsize(j.head_path) - 3)
+        j2 = _mkjournal(tmp_path, clock=lambda: 9.0)
+        _fill(j2, 5, start=100)
+        j2.close(clean=True)
+        recs, stats = blackbox.decode_dir(j2.dir)
+        # the reopen truncated the torn record 9; appends follow cleanly
+        assert stats["corrupt_skipped"] == 0 and not stats["torn_tail"]
+        spans = [p["span"] for k, p in recs if k == blackbox.REC_SPAN]
+        assert spans == list(range(9)) + [100, 101, 102, 103, 104]
+
+
+class TestPostmortem:
+    def test_reconstruction_of_in_flight_round(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        j.on_event("boot", {"node": 1})
+        # a committed round: OPEN + completed span
+        j.append(blackbox.REC_OPEN, {
+            "stage": "consensus.round", "span": 10, "trace": 10, "t0": 1.0,
+            "attrs": {"h": 4, "r": 0, "node": 1},
+        }, sync=j.SYNC_FLUSH)
+        j.append(blackbox.REC_SPAN, {
+            "stage": "consensus.round", "span": 10, "trace": 10,
+            "t0": 1.0, "t1": 2.0, "dur_ms": 1000.0,
+            "attrs": {"h": 4, "r": 0, "node": 1, "committed": True},
+        })
+        # the in-flight round: OPEN with no completion
+        j.append(blackbox.REC_OPEN, {
+            "stage": "consensus.round", "span": 20, "trace": 20, "t0": 2.0,
+            "attrs": {"h": 5, "r": 1, "node": 1},
+        }, sync=j.SYNC_FLUSH)
+        j.append(blackbox.REC_SPAN, {
+            "stage": "consensus.step", "span": 21, "trace": 20, "parent": 20,
+            "t0": 2.0, "t1": 2.3, "dur_ms": 300.0,
+            "attrs": {"h": 5, "r": 1, "node": 1, "step": "RoundStepPropose"},
+        })
+        j.on_event("quorum", {"h": 5, "r": 1, "node": 1,
+                              "key": "q_prevote_ms", "ms": 420.0})
+        j.append(blackbox.REC_SPAN, {
+            "stage": "verify.dispatch", "span": 22, "trace": 20,
+            "t0": 2.4, "t1": 2.5, "dur_ms": 100.0,
+            "attrs": {"tier": "pallas", "lanes": 64, "n": 40, "dispatch": 7},
+        })
+        # the watchdog anomaly that followed it: fsync'd, so the dispatch
+        # span buffered just before it survives the kill too
+        j.append(
+            blackbox.REC_ANOMALY,
+            {"kind": "watchdog_fire", "t": 2.6,
+             "attrs": {"tier": "pallas", "lanes": 64, "dispatch": 7}},
+            sync=j.SYNC_FSYNC,
+        )
+        j.kill()
+
+        rep = blackbox.postmortem_report(j.dir)
+        assert rep["unclean_shutdown"] is True
+        assert rep["last_committed_height"] == 4
+        inf = rep["in_flight"]
+        assert (inf["h"], inf["r"], inf["node"]) == (5, 1, 1)
+        assert inf["steps"] == {"RoundStepPropose": 300.0}
+        assert inf["quorum"] == {"q_prevote_ms": 420.0}
+        assert rep["last_dispatch"] == {
+            "tier": "pallas", "lanes": 64, "n": 40, "dispatch": 7, "t1": 2.5,
+        }
+        assert [s["stage"] for s in rep["open_spans"]] == ["consensus.round"]
+
+    def test_boot_event_retires_previous_incarnations_opens(self, tmp_path):
+        """An unfinished round OPEN from a crashed run must not read as
+        'open at death' of the NEXT incarnation: its process is gone."""
+        j = _mkjournal(tmp_path, flush_every=1)
+        j.on_event("boot", {"node": 0})
+        j.append(blackbox.REC_OPEN, {
+            "stage": "consensus.round", "span": 4, "trace": 4, "t0": 1.0,
+            "attrs": {"h": 9, "r": 0},
+        }, sync=j.SYNC_FLUSH)
+        j.kill()
+        j2 = _mkjournal(tmp_path, clock=lambda: 5.0, flush_every=1)
+        j2.on_event("boot", {"node": 0})
+        _fill(j2, 3, start=50)
+        j2.kill()
+        rep = blackbox.postmortem_report(j2.dir)
+        assert rep["unclean_shutdown"] is True
+        assert rep["in_flight"] is None  # h=9 died with the FIRST process
+        assert rep["open_spans"] == []
+
+    def test_steps_scoped_to_last_incarnation(self, tmp_path):
+        """A restarted node re-enters the SAME (h, r); the previous
+        incarnation's step spans must not masquerade as the final run's
+        progress."""
+        j = _mkjournal(tmp_path, flush_every=1)
+        j.on_event("boot", {"node": 0})
+        j.append(blackbox.REC_SPAN, {
+            "stage": "consensus.step", "span": 3, "trace": 2,
+            "t0": 1.0, "t1": 1.2, "dur_ms": 200.0,
+            "attrs": {"h": 5, "r": 0, "step": "RoundStepPrevote"},
+        })
+        j.kill()
+        j2 = _mkjournal(tmp_path, clock=lambda: 8.0, flush_every=1)
+        j2.on_event("boot", {"node": 0})
+        j2.append(blackbox.REC_OPEN, {
+            "stage": "consensus.round", "span": 9, "trace": 9, "t0": 8.0,
+            "attrs": {"h": 5, "r": 0, "node": 0},
+        }, sync=j2.SYNC_FLUSH)
+        j2.append(blackbox.REC_SPAN, {
+            "stage": "consensus.step", "span": 10, "trace": 9, "parent": 9,
+            "t0": 8.0, "t1": 8.1, "dur_ms": 100.0,
+            "attrs": {"h": 5, "r": 0, "step": "RoundStepPropose"},
+        })
+        j2.kill()
+        rep = blackbox.postmortem_report(j2.dir)
+        # only the final life's propose — NOT the dead run's prevote
+        assert rep["in_flight"]["steps"] == {"RoundStepPropose": 100.0}
+
+    def test_accepts_node_home_dirs(self, tmp_path):
+        d = tmp_path / "home" / "data" / "blackbox"
+        j = blackbox.BlackboxJournal(str(d), threaded=False,
+                                     clock=lambda: 1.0)
+        _fill(j, 3)
+        j.close(clean=True)
+        rep = blackbox.postmortem_report(str(tmp_path / "home"))
+        assert rep["clean_close"] is True
+        assert rep["journal"]["records"] == 4
+
+    def test_boot_report(self, tmp_path):
+        assert blackbox.boot_report(str(tmp_path / "nothing")) is None
+        j = _mkjournal(tmp_path)
+        _fill(j, 2)
+        j.kill()
+        rep = blackbox.boot_report(j.dir)
+        assert rep is not None and rep["unclean_shutdown"] is True
+
+
+class TestHealthRecords:
+    def test_periodic_health_snapshot_every_n_records(self, tmp_path):
+        j = _mkjournal(tmp_path, health_every=10)
+        _fill(j, 25)
+        j.close(clean=True)
+        recs, _stats = blackbox.decode_dir(j.dir)
+        health = [p for k, p in recs if k == blackbox.REC_HEALTH]
+        assert len(health) == 2  # after the 10th and the 20th+health record
+        for h in health:
+            # the four pipeline sections, jax-free snapshots
+            assert {"sched", "ingest", "dispatch", "warmboot"} <= set(h)
+        rep = blackbox.postmortem_report(j.dir)
+        assert rep["health"] is not None
+
+    def test_health_disabled_with_none(self, tmp_path):
+        j = _mkjournal(tmp_path, health_every=None)
+        _fill(j, 40)
+        j.close(clean=True)
+        recs, _stats = blackbox.decode_dir(j.dir)
+        assert not any(k == blackbox.REC_HEALTH for k, _ in recs)
+
+
+class TestTracerIntegration:
+    def test_sinks_feed_journal_from_tracer(self, tmp_path):
+        j = blackbox.open_journal(str(tmp_path / "bb"), threaded=False,
+                                  clock=lambda: 1.0)
+        tr = tracing.get_tracer()
+        with tr.span("verify.batch", n=8):
+            pass
+        sp = tr.begin("consensus.round", h=9, r=0, node=3)
+        tracing.note_event("breaker_close", backend="xla")
+        tr.record_anomaly("queue_shed", cls="bulk")
+        tr.record_anomaly("queue_shed", cls="bulk")  # EVERY occurrence
+        blackbox.close_journal(clean=True)
+        recs, stats = blackbox.decode_dir(str(tmp_path / "bb"))
+        kinds = [k for k, _ in recs]
+        assert kinds.count(blackbox.REC_ANOMALY) == 2
+        assert kinds.count(blackbox.REC_OPEN) == 1
+        assert kinds.count(blackbox.REC_SPAN) == 1
+        events = [p for k, p in recs if k == blackbox.REC_EVENT]
+        assert any(p["kind"] == "breaker_close" for p in events)
+        rep = blackbox.postmortem_report(str(tmp_path / "bb"))
+        assert rep["breakers"] == {"xla": {"state": "closed", "t": 1.0}}
+        inf = rep["in_flight"]
+        assert (inf["h"], inf["r"]) == (9, 0)
+        tr.finish(sp)
+
+    def test_displaced_journal_can_still_close_clean(self, tmp_path):
+        """Two in-process nodes: node B's open_journal repoints the sinks
+        but must NOT close node A's journal — A still writes its
+        clean-close sentinel at its own graceful stop, so A's next boot
+        does not false-positive an unclean shutdown."""
+        a = blackbox.open_journal(str(tmp_path / "a"), threaded=False)
+        b = blackbox.open_journal(str(tmp_path / "b"), threaded=False)
+        assert blackbox.get_journal() is b
+        assert not a.closed
+        a.close(clean=True)  # node A's on_stop fallback branch
+        blackbox.close_journal(clean=True)
+        for d in ("a", "b"):
+            rep = blackbox.boot_report(str(tmp_path / d))
+            assert rep["clean_close"] is True, d
+            assert rep["unclean_shutdown"] is False, d
+
+    def test_kill_switch_restores_ram_only_recorder(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_BLACKBOX", "0")
+        assert blackbox.open_journal(str(tmp_path / "bb")) is None
+        assert all(
+            tracing.get_sink(k) is None
+            for k in ("span", "open", "anomaly", "event")
+        )
+        tr = tracing.get_tracer()
+        with tr.span("verify.batch"):
+            pass
+        assert tr.snapshot()["spans_recorded"] == 1
+        assert not os.path.exists(str(tmp_path / "bb"))
+
+    def test_journal_in_trace_document(self, tmp_path):
+        blackbox.open_journal(str(tmp_path / "bb"), threaded=False)
+        with tracing.span("verify.batch"):
+            pass
+        doc = tracing.trace_document(max_spans=4, rounds=0)
+        assert doc["blackbox"]["records"] >= 1
+        assert "device" in doc
+        blackbox.close_journal(clean=False)
+
+
+class TestGC:
+    def test_gc_dir_prunes_rolled_segments_keeps_head(self, tmp_path):
+        j = _mkjournal(tmp_path, segment_bytes=1024, segments=10)
+        _fill(j, 400)
+        j.close(clean=True)
+        n_before = len(blackbox.segment_files(j.dir))
+        assert n_before > 3
+        removed, freed = blackbox.gc_dir(str(tmp_path), max_segments=2,
+                                         dry_run=True)
+        assert removed == n_before - 2 and freed > 0
+        assert len(blackbox.segment_files(j.dir)) == n_before  # dry run
+        removed, _ = blackbox.gc_dir(str(tmp_path), max_segments=2)
+        assert removed == n_before - 2
+        files = blackbox.segment_files(j.dir)
+        assert len(files) == 2
+        assert files[-1].endswith(blackbox.HEAD_NAME)
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["corrupt_skipped"] == 0  # survivors intact
+
+
+_CHILD = r"""
+import os, signal, sys, time
+sys.path.insert(0, sys.argv[2])
+from cometbft_tpu.libs import blackbox, tracing
+
+j = blackbox.open_journal(sys.argv[1], threaded=True)
+tr = tracing.get_tracer()
+for i in range(40):
+    with tr.span("verify.batch", n=i):
+        pass
+with tr.span("verify.dispatch", tier="xla", lanes=32, dispatch=5):
+    pass
+tr.begin("consensus.round", h=7, r=2, node=0)
+tr.record_anomaly("watchdog_fire", tier="xla", lanes=32, dispatch=5)
+# let the async writer drain + fsync before dying: durability is only as
+# good as what the writer flushed before the kill — like any black box
+while j.stats()["queued"] or j.stats()["records"] < 43:
+    time.sleep(0.02)
+time.sleep(0.2)
+print("READY", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+class TestCrossProcess:
+    def test_decode_journal_of_sigkilled_subprocess(self, tmp_path):
+        """The end-to-end story: another PROCESS journals through the
+        node's own plumbing (open_journal + tracer sinks), dies by
+        SIGKILL, and this process reconstructs its final timeline."""
+        bb_dir = str(tmp_path / "bb")
+        env = dict(os.environ)
+        env.pop("COMETBFT_TPU_BLACKBOX", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD, bb_dir, REPO],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert proc.returncode == -signal.SIGKILL
+        assert "READY" in proc.stdout
+        rep = blackbox.postmortem_report(bb_dir)
+        assert rep["unclean_shutdown"] is True
+        # the fsync'd anomaly survived the kill -9; the round anchor's
+        # flushed OPEN did too
+        assert rep["anomaly_counts"] == {"watchdog_fire": 1}
+        inf = rep["in_flight"]
+        assert (inf["h"], inf["r"]) == (7, 2)
+        ld = rep["last_dispatch"]
+        assert (ld["tier"], ld["lanes"], ld["dispatch"]) == ("xla", 32, 5)
+
+
+class TestSimForensics:
+    """The acceptance criterion: after SimCluster.crash(i) mid-round the
+    dead node's journal reconstructs the in-flight round, and the
+    reconstruction is byte-deterministic per seed."""
+
+    def test_crash_restart_scenario_captures_postmortems(self, tmp_path):
+        from cometbft_tpu.sim import run_scenario
+
+        res = run_scenario("crash-restart", 42, root=tmp_path)
+        assert res.reached and not res.violations
+        assert res.blackbox["records"] > 0
+        assert res.blackbox["dropped"] == 0
+        assert len(res.postmortems) == 1
+        pm = res.postmortems[0]
+        assert pm["node"] == 1
+        rep = pm["report"]
+        assert rep["unclean_shutdown"] is True
+        inf = rep["in_flight"]
+        assert inf is not None and isinstance(inf["h"], int)
+        assert rep["last_committed_height"] >= 1
+        # the digest rides the byte-compared trace
+        assert any("postmortem" in line for line in res.trace)
+
+    def test_postmortem_byte_deterministic_per_seed(self, tmp_path):
+        from cometbft_tpu.sim import run_scenario
+
+        a = run_scenario("crash-restart", 7, root=tmp_path / "a")
+        b = run_scenario("crash-restart", 7, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert json.dumps(a.postmortems, sort_keys=True) == json.dumps(
+            b.postmortems, sort_keys=True
+        )
+        assert a.blackbox == b.blackbox
+
+    @pytest.mark.slow
+    def test_fleet_churn_postmortem_deterministic(self, tmp_path):
+        from cometbft_tpu.sim import run_scenario
+
+        a = run_scenario("fleet-churn", 11, root=tmp_path / "a")
+        b = run_scenario("fleet-churn", 11, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert json.dumps(a.postmortems, sort_keys=True) == json.dumps(
+            b.postmortems, sort_keys=True
+        )
+
+    def test_segment_budget_holds_under_scenario(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_BLACKBOX_SEGMENT_BYTES", "8192")
+        monkeypatch.setenv("COMETBFT_TPU_BLACKBOX_SEGMENTS", "2")
+        from cometbft_tpu.sim import run_scenario
+
+        res = run_scenario(
+            "baseline", 3, root=tmp_path, keep_cluster=True
+        )
+        assert res.reached
+        try:
+            budget = 2 * 8192 + 256  # + one frame of slack
+            for i, j in res.cluster.blackbox.items():
+                files = blackbox.segment_files(j.dir)
+                assert len(files) <= 2, f"node{i} kept {len(files)} segments"
+                total = sum(os.path.getsize(f) for f in files)
+                assert total <= budget, f"node{i} journal {total}B > budget"
+        finally:
+            res.cluster.stop()
+
+    def test_blackbox_disabled_restores_ram_only_run(self, tmp_path,
+                                                     monkeypatch):
+        from cometbft_tpu.sim import run_scenario
+
+        on = run_scenario("baseline", 5, root=tmp_path / "on")
+        monkeypatch.setenv("COMETBFT_TPU_BLACKBOX", "0")
+        off = run_scenario("baseline", 5, root=tmp_path / "off")
+        # the RAM recorder's view of the run is bit-for-bit unchanged
+        assert on.trace == off.trace
+        assert on.spans == off.spans
+        assert off.blackbox == {}
+        assert not (tmp_path / "off" / "node0" / "blackbox").exists()
+
+
+class TestRpcAndCli:
+    def test_debug_postmortem_route(self, tmp_path):
+        from cometbft_tpu.rpc import core as rpccore
+
+        assert rpccore.ROUTES["debug_postmortem"] == "debug_postmortem"
+        assert rpccore.ROUTES["debug/postmortem"] == "debug_postmortem"
+
+        boot = {"unclean_shutdown": True, "in_flight": {"h": 3, "r": 1}}
+
+        class _Node:
+            boot_postmortem = boot
+
+        blackbox.open_journal(str(tmp_path / "bb"), threaded=False)
+        try:
+            with tracing.span("verify.batch"):
+                pass
+            doc = rpccore.Environment(_Node()).debug_postmortem()
+        finally:
+            blackbox.close_journal(clean=False)
+        assert doc["unclean_shutdown"] is True
+        assert doc["boot"] is boot
+        assert doc["journal"]["records"] >= 1
+        json.dumps(doc)  # one JSON document
+
+    def test_postmortem_cli_json_and_human(self, tmp_path):
+        from cometbft_tpu.cmd.main import main as cli_main
+
+        j = _mkjournal(tmp_path, flush_every=1)
+        j.append(blackbox.REC_OPEN, {
+            "stage": "consensus.round", "span": 5, "trace": 5, "t0": 1.0,
+            "attrs": {"h": 2, "r": 0, "node": 0},
+        }, sync=j.SYNC_FLUSH)
+        j.kill()
+        rc = cli_main(["postmortem", j.dir])
+        assert rc == 0
+        rc = cli_main(["postmortem", j.dir, "--json"])
+        assert rc == 0
+        assert cli_main(["postmortem", str(tmp_path / "missing")]) == 1
+
+    def test_postmortem_cli_json_matches_report(self, tmp_path, capfd):
+        from cometbft_tpu.cmd.main import main as cli_main
+
+        j = _mkjournal(tmp_path, flush_every=1)
+        _fill(j, 5)
+        j.kill()
+        assert cli_main(["postmortem", j.dir, "--json"]) == 0
+        out = json.loads(capfd.readouterr().out)
+        assert out == blackbox.postmortem_report(j.dir)
+
+    def test_exec_cache_gc_blackbox_mode(self, tmp_path, capsys,
+                                         monkeypatch):
+        j = _mkjournal(tmp_path, segment_bytes=1024, segments=10)
+        _fill(j, 400)
+        j.close(clean=True)
+        import scripts.exec_cache_gc as gc_script
+
+        monkeypatch.setattr(
+            sys, "argv",
+            ["exec_cache_gc.py", "--blackbox", str(tmp_path),
+             "--segments", "2"],
+        )
+        assert gc_script.main() == 0
+        assert "blackbox-gc" in capsys.readouterr().out
+        assert len(blackbox.segment_files(j.dir)) == 2
+
+
+class TestDeviceHealth:
+    def test_probe_transitions_are_journaled(self, tmp_path):
+        from cometbft_tpu.ops import device_health
+
+        device_health.reset()
+        blackbox.open_journal(str(tmp_path / "bb"), threaded=False)
+        try:
+            assert device_health.record_probe(True, platform="tpu") is True
+            assert device_health.record_probe(True, platform="tpu") is False
+            assert device_health.record_probe(False) is True  # the outage
+            snap = device_health.snapshot()
+            assert snap["up"] is False and snap["up_code"] == 0
+            assert snap["transitions"] == 1 and snap["probes"] == 3
+        finally:
+            blackbox.close_journal(clean=True)
+            device_health.reset()
+        rep = blackbox.postmortem_report(str(tmp_path / "bb"))
+        ups = [e["attrs"]["up"] for e in rep["device_events"]]
+        assert ups == [True, False]  # first probe + the flip; no repeats
+
+    def test_status_file_roundtrip(self, tmp_path, monkeypatch):
+        from cometbft_tpu.ops import device_health
+
+        device_health.reset()
+        status = tmp_path / "chipwatch_status.json"
+        status.write_text(json.dumps(
+            {"t": 123.0, "up": True, "platform": "tpu", "init_s": 4.2}
+        ))
+        monkeypatch.setenv("COMETBFT_TPU_CHIP_STATUS", str(status))
+        snap = device_health.snapshot()
+        assert snap["up"] is True and snap["platform"] == "tpu"
+        assert snap["source"] == "chipwatch"
+        # unchanged mtime -> no re-read, no new probe
+        probes = snap["probes"]
+        assert device_health.snapshot()["probes"] == probes
+        device_health.reset()
+
+    def test_torn_status_file_is_retried_not_dropped(self, tmp_path,
+                                                     monkeypatch):
+        """A mid-write (torn) status read must not consume the update:
+        the next poll retries the same mtime and picks it up."""
+        from cometbft_tpu.ops import device_health
+
+        device_health.reset()
+        status = tmp_path / "chipwatch_status.json"
+        status.write_text('{"t": 1.0, "up": fal')  # torn JSON
+        monkeypatch.setenv("COMETBFT_TPU_CHIP_STATUS", str(status))
+        assert device_health.poll_status_file() is False
+        # the writer finishes; mtime does not move past the torn read
+        mtime = os.path.getmtime(status)
+        status.write_text(json.dumps({"t": 1.0, "up": False}))
+        os.utime(status, (mtime, mtime))
+        assert device_health.poll_status_file() is True
+        assert device_health.snapshot()["up"] is False
+        device_health.reset()
+
+    def test_device_up_gauge_renders(self):
+        from cometbft_tpu.libs.metrics import NodeMetrics
+        from cometbft_tpu.ops import device_health
+
+        device_health.reset()
+        try:
+            device_health.record_probe(True, platform="tpu")
+            page = NodeMetrics().registry.expose()
+            assert "cometbft_device_up 1" in page
+        finally:
+            device_health.reset()
