@@ -1073,6 +1073,126 @@ def run_obs(emit, n=128, reps=3) -> dict:
     return rec
 
 
+def run_meshfault(emit, n=256, reps=3, width=4) -> dict:
+    """Elastic-mesh fault stage (docs/backend-supervisor.md "Fault
+    isolation"): healthy full-width dispatch vs one-dead-chip dispatch
+    on the per-shard host-oracle runner seam (``parallel/elastic``) —
+    the same seam the chip-death sim scenario drives, so the numbers are
+    deterministic and platform-independent.  Asserted hard:
+
+      * verdicts bitwise-equal between the healthy mesh, the
+        shrunken mesh, and the host ZIP-215 oracle;
+      * exactly ONE shrink for a persistent dead chip (the failed
+        dispatch alone re-runs; the open breaker excludes the corpse
+        from every later dispatch — no per-dispatch retry tax);
+      * dispatches-per-1k-sigs returns to the healthy rate once the
+        breaker is open (trend-gated via ``dispatches_per_1k``).
+
+    Walls (healthy vs first-fault dispatch latency) are advisory on the
+    throttled host.  Emitted as stage="meshfault" and written to
+    BENCH_MESHFAULT.json for the bench_trend gate."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.ops import dispatch_stats
+    from cometbft_tpu.parallel import elastic
+
+    pubs, msgs, sigs = _make_batch(n)
+    # two invalid lanes so shrink re-dispatch is exercised on a mixed
+    # batch, not just the happy path
+    sigs = list(sigs)
+    sigs[1] = sigs[1][:-1] + bytes([sigs[1][-1] ^ 1])
+    sigs[n - 2] = bytes(64)
+    expected = np.array(
+        [ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)],
+        dtype=bool,
+    )
+
+    saved_thr = os.environ.get("COMETBFT_TPU_BREAKER_THRESHOLD")
+    os.environ["COMETBFT_TPU_BREAKER_THRESHOLD"] = "1"
+    try:
+        def timed_run() -> "tuple[list[float], int]":
+            dispatch_stats.reset()
+            walls = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                bits = elastic.verify_elastic(pubs, msgs, sigs)
+                walls.append(time.perf_counter() - t0)
+                assert (bits == expected).all(), "verdicts diverged"
+            return walls, dispatch_stats.snapshot()["dispatches"]
+
+        # healthy: full width, one dispatch per verify
+        backend_health.reset()
+        elastic.clear()
+        elastic.configure(range(width))
+        elastic.set_mesh_runner(elastic.host_oracle_runner)
+        healthy_walls, healthy_disp = timed_run()
+
+        # one dead chip, persistent: first dispatch shrinks (re-dispatch),
+        # every later dispatch runs at width-1 with no retry tax
+        backend_health.reset()
+        elastic.clear()
+        elastic.configure(range(width))
+        elastic.set_mesh_runner(elastic.host_oracle_runner)
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("raise", ordinals=(1,))
+        )
+        dead_walls, dead_disp = timed_run()
+        snap = dispatch_stats.snapshot()
+        shrinks = snap["mesh_shrinks"]
+        post_width = snap["mesh_width"]
+    finally:
+        elastic.clear()
+        backend_health.reset()
+        if saved_thr is None:
+            os.environ.pop("COMETBFT_TPU_BREAKER_THRESHOLD", None)
+        else:
+            os.environ["COMETBFT_TPU_BREAKER_THRESHOLD"] = saved_thr
+
+    total_sigs = reps * n
+    rec = {
+        "metric": "mesh_fault_isolation",
+        "stage": "meshfault",
+        "batch": n,
+        "reps": reps,
+        "width": width,
+        "post_fault_width": post_width,
+        "shrinks": shrinks,
+        "dispatches_per_1k_sigs_healthy": round(
+            1000.0 * healthy_disp / total_sigs, 3
+        ),
+        "dispatches_per_1k_sigs_dead": round(
+            1000.0 * dead_disp / total_sigs, 3
+        ),
+        "healthy_dispatch_ms_p50": round(
+            sorted(healthy_walls)[len(healthy_walls) // 2] * 1e3, 3
+        ),
+        "fault_dispatch_ms": round(dead_walls[0] * 1e3, 3),
+        "post_fault_dispatch_ms_p50": round(
+            sorted(dead_walls[1:])[(reps - 1) // 2] * 1e3, 3
+        )
+        if reps > 1
+        else None,
+    }
+    emit(rec)
+    # hard invariants (dispatch counts; walls stay advisory)
+    assert shrinks == 1, f"expected exactly one shrink, got {shrinks}"
+    assert post_width == width - 1, (width, post_width)
+    assert dead_disp == healthy_disp + 1, (
+        "dead-chip run must cost exactly one extra dispatch "
+        f"(the single re-dispatch): {healthy_disp} -> {dead_disp}"
+    )
+    out = os.path.join(REPO, "BENCH_MESHFAULT.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return rec
+
+
 def _loopback_cache_hit_rate() -> float:
     """Gossip-verify one round of precommits into a VoteSet, then re-verify
     the commit assembled from them (the apply-time LastCommit check) — the
@@ -1910,6 +2030,16 @@ def main() -> None:
         "sizes the batch",
     )
     ap.add_argument(
+        "--meshfault",
+        action="store_true",
+        help="run only the elastic-mesh fault stage: healthy full-width "
+        "dispatch vs one-dead-chip dispatch on the per-shard host-oracle "
+        "runner seam — verdict equality, exactly one shrink, and "
+        "dispatches-per-1k-sigs asserted hard, walls advisory; writes "
+        "BENCH_MESHFAULT.json for the bench_trend gate; "
+        "BENCH_MESHFAULT_BATCH / _WIDTH size the run",
+    )
+    ap.add_argument(
         "--warmboot",
         action="store_true",
         help="run only the warm-boot pipeline stage: two cold processes "
@@ -1992,6 +2122,14 @@ def main() -> None:
         )
     elif args.obs:
         run_obs(_emit, n=int(os.environ.get("BENCH_OBS_BATCH", "128")))
+    elif args.meshfault:
+        # jax-free by construction (host-oracle shard runner): no
+        # compilation cache plumbing needed
+        run_meshfault(
+            _emit,
+            n=int(os.environ.get("BENCH_MESHFAULT_BATCH", "256")),
+            width=int(os.environ.get("BENCH_MESHFAULT_WIDTH", "4")),
+        )
     elif args.warmboot:
         run_warmboot(_emit)
     elif args.worker:

@@ -366,6 +366,16 @@ def cmd_trace(args) -> int:
                 dev.get("source"),
             )
         )
+    disp = doc.get("dispatch", {})
+    if disp.get("mesh_width"):
+        print(
+            "mesh: width=%s shrinks=%s restores=%s"
+            % (
+                disp.get("mesh_width"),
+                disp.get("mesh_shrinks"),
+                disp.get("mesh_restores"),
+            )
+        )
     bb = doc.get("blackbox", {})
     if bb and "records" in bb:
         print(
@@ -511,9 +521,30 @@ def cmd_postmortem(args) -> int:
     ld = report["last_dispatch"]
     if ld:
         print(
-            "last dispatch: tier=%s lanes=%s n=%s ordinal=%s"
-            % (ld["tier"], ld["lanes"], ld["n"], ld["dispatch"])
+            "last dispatch: tier=%s lanes=%s n=%s ordinal=%s%s"
+            % (
+                ld["tier"],
+                ld["lanes"],
+                ld["n"],
+                ld["dispatch"],
+                " mesh=%s" % ld["mesh"] if ld.get("mesh") else "",
+            )
         )
+    mesh = report.get("mesh") or {}
+    if mesh.get("width") is not None:
+        print("mesh width at death: %s" % mesh["width"])
+        for ev in mesh.get("events") or ():
+            a = ev.get("attrs") or {}
+            print(
+                "  mesh reconfig t=%s width=%s reason=%s%s%s"
+                % (
+                    ev.get("t"),
+                    a.get("width"),
+                    a.get("reason"),
+                    " excluded=%s" % a["excluded"] if "excluded" in a else "",
+                    " restored=%s" % a["restored"] if "restored" in a else "",
+                )
+            )
     for sp in report["open_spans"]:
         print(
             "open span at death: %s (span=%s t0=%s) %s"

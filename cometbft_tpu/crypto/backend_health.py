@@ -190,6 +190,21 @@ class CircuitBreaker:
                 repromotions=self._repromotions,
             )
 
+    def _open_locked(self) -> bool:
+        """The OPEN transition, under the lock — shared by organic
+        failures (``record_failure``) and proactive trips (``trip``) so
+        the two can never drift.  Returns True when this call NEWLY
+        opened the breaker (an already-open, unelapsed window only has
+        its backoff refreshed, without re-counting the open)."""
+        already_open = self._state == OPEN and self.clock() < self._open_until
+        self._state = OPEN
+        if not already_open:
+            self._opens += 1
+        self._open_until = self.clock() + self._backoff_s
+        # exponential backoff for the NEXT half-open window
+        self._backoff_s = min(self._backoff_s * 2, self.backoff_max_s)
+        return not already_open
+
     def record_failure(self, err: Optional[BaseException] = None) -> None:
         opened = False
         with self._lock:
@@ -201,35 +216,51 @@ class CircuitBreaker:
             if was_probe or (
                 self._state == CLOSED and self._failures >= self.threshold
             ):
-                self._state = OPEN
-                self._opens += 1
-                opened = True
-                self._open_until = self.clock() + self._backoff_s
-                # exponential backoff for the NEXT half-open window
-                self._backoff_s = min(self._backoff_s * 2, self.backoff_max_s)
+                opened = self._open_locked()
             self._probe_inflight = False
         if opened:
-            # flight-recorder anomaly (docs/observability.md), recorded
-            # OUTSIDE the breaker lock: the first open since reset dumps
-            # the span ring for postmortem.  The ed25519 degradation-chain
-            # tiers share one taxonomy kind (one chain, one story); every
-            # OTHER breaker — secp_device, bls_g1, and any single-tier
-            # backend added later — automatically gets its own
-            # ``breaker_open_<name>`` kind, so its first open still dumps
-            # even after an ed25519-tier open latched the shared kind.
-            from cometbft_tpu.libs import tracing
+            self._emit_open_anomaly()
 
-            kind = (
-                "breaker_open"
-                if self.name in _ED25519_CHAIN_TIERS
-                else f"breaker_open_{self.name}"
-            )
-            tracing.record_anomaly(
-                kind,
-                backend=self.name,
-                opens=self._opens,
-                error=self._last_error,
-            )
+    def trip(self, reason: str = "") -> None:
+        """Force the breaker OPEN immediately — proactive exclusion: an
+        out-of-band health signal (an ``ops/device_health`` down-probe, a
+        chip-watcher status flip) reported the backend dead, so the next
+        dispatch must not pay a failure to find out.  Counts as one
+        failure; re-admission rides the normal half-open backoff."""
+        opened = False
+        with self._lock:
+            self._failures += 1
+            self._failures_total += 1
+            if reason:
+                self._last_error = reason[:200]
+            opened = self._open_locked()
+            self._probe_inflight = False
+        if opened:
+            self._emit_open_anomaly()
+
+    def _emit_open_anomaly(self) -> None:
+        # flight-recorder anomaly (docs/observability.md), recorded
+        # OUTSIDE the breaker lock: the first open since reset dumps
+        # the span ring for postmortem.  The ed25519 degradation-chain
+        # tiers share one taxonomy kind (one chain, one story); every
+        # OTHER breaker — secp_device, bls_g1, the per-ordinal mesh_dev*
+        # breakers, and any single-tier backend added later —
+        # automatically gets its own ``breaker_open_<name>`` kind, so its
+        # first open still dumps even after an ed25519-tier open latched
+        # the shared kind.
+        from cometbft_tpu.libs import tracing
+
+        kind = (
+            "breaker_open"
+            if self.name in _ED25519_CHAIN_TIERS
+            else f"breaker_open_{self.name}"
+        )
+        tracing.record_anomaly(
+            kind,
+            backend=self.name,
+            opens=self._opens,
+            error=self._last_error,
+        )
 
     # -- introspection -----------------------------------------------------
 

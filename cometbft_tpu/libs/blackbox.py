@@ -683,6 +683,8 @@ def postmortem_report(dir_: str, recent: int = 16) -> dict:
     health: Optional[dict] = None
     quorum_events: "list[dict]" = []
     device_events: "list[dict]" = []
+    mesh_events: "list[dict]" = []
+    mesh_width = None  # elastic mesh width at death (last reconfig)
     spans_total = 0
 
     step_spans: "list[dict]" = []  # last incarnation's consensus.step spans
@@ -708,6 +710,11 @@ def postmortem_report(dir_: str, recent: int = 16) -> dict:
                     "dispatch": attrs.get("dispatch"),
                     "t1": p.get("t1"),
                 }
+                if attrs.get("mesh") is not None:
+                    # mesh width the dispatch targeted (single-chip
+                    # dispatches carry no key) -- "which fleet shape was
+                    # live when it died" is a first-class question
+                    last_dispatch["mesh"] = attrs.get("mesh")
         elif kind == REC_ANOMALY:
             k = p.get("kind", "?")
             anomaly_counts[k] = anomaly_counts.get(k, 0) + 1
@@ -735,6 +742,8 @@ def postmortem_report(dir_: str, recent: int = 16) -> dict:
                 opens.clear()
                 quorum_events.clear()
                 step_spans.clear()
+                mesh_events.clear()
+                mesh_width = None
             elif k == "breaker_close" and a.get("backend"):
                 _fold_breaker(
                     breakers,
@@ -745,6 +754,10 @@ def postmortem_report(dir_: str, recent: int = 16) -> dict:
                 quorum_events.append(p)
             elif k == "device_probe":
                 device_events.append(p)
+            elif k == "mesh.reconfig":
+                mesh_events.append(p)
+                if a.get("width") is not None:
+                    mesh_width = a.get("width")
         elif kind == REC_HEALTH:
             health = p
 
@@ -797,6 +810,13 @@ def postmortem_report(dir_: str, recent: int = 16) -> dict:
         "in_flight": in_flight,
         "open_spans": open_spans,
         "last_dispatch": last_dispatch,
+        # elastic mesh state at death: the last reconfiguration's width
+        # plus the recent membership events (shrinks, probe exclusions,
+        # restores) of the final incarnation
+        "mesh": {
+            "width": mesh_width,
+            "events": mesh_events[-recent:],
+        },
         "spans_total": spans_total,
         "anomaly_counts": anomaly_counts,
         "anomalies": anomalies[-recent:],

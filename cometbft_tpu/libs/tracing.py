@@ -98,6 +98,15 @@ ANOMALY_KINDS = (
     "ingest_shed",
     "quarantine",
     "exec_cache_stale",
+    # elastic mesh supervision (parallel/elastic, docs/backend-supervisor
+    # "Fault isolation"): a shard abandoned past the watchdog, a device
+    # removed from mesh membership (shard failure or proactive
+    # probe-down), and a device re-admitted by a passing half-open probe.
+    # Per-ordinal breaker opens additionally get their own
+    # ``breaker_open_mesh_dev{N}`` kinds via backend_health.
+    "shard_watchdog_fire",
+    "mesh_shrink",
+    "mesh_restore",
 )
 
 

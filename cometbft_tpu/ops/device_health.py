@@ -45,6 +45,10 @@ def _fresh() -> dict:
         "last_probe_t": None,
         "source": "",
         "_file_mtime": 0.0,
+        # per-ordinal availability on a multi-chip host (stable physical
+        # ordinal -> bool): a down-transition proactively removes the
+        # chip from elastic mesh membership (parallel/elastic.note_probe)
+        "ordinals": {},
     }
 
 
@@ -57,10 +61,20 @@ def record_probe(
     init_s: Optional[float] = None,
     source: str = "probe",
     t: Optional[float] = None,
+    ordinal: Optional[int] = None,
 ) -> bool:
     """Record one probe result; returns True when the availability state
     CHANGED (first probe, or an up↔down flip).  Transitions are journaled
-    as black-box ``device_probe`` events — a no-op without a journal."""
+    as black-box ``device_probe`` events — a no-op without a journal.
+
+    With ``ordinal`` the probe targets ONE chip of a multi-chip host:
+    the per-ordinal state is tracked separately, the journaled event
+    carries the ordinal, and a down-transition tells the elastic mesh
+    supervisor to exclude the chip from membership BEFORE the next
+    dispatch (its ``mesh_dev{N}`` breaker trips; re-admission rides the
+    breaker's half-open probe)."""
+    if ordinal is not None:
+        return _record_ordinal_probe(int(ordinal), bool(up), source, t)
     t = time.time() if t is None else t
     with _LOCK:
         prev = _S["up"]
@@ -85,6 +99,35 @@ def record_probe(
             platform=platform,
             source=source,
         )
+    return changed
+
+
+def _record_ordinal_probe(
+    ordinal: int, up: bool, source: str, t: Optional[float]
+) -> bool:
+    t = time.time() if t is None else t
+    with _LOCK:
+        prev = _S["ordinals"].get(ordinal)
+        changed = prev is None or prev != up
+        _S["ordinals"][ordinal] = up
+        _S["probes"] += 1
+        _S["last_probe_t"] = t
+        _S["source"] = source
+        if changed:
+            if prev is not None:
+                _S["transitions"] += 1
+            _S["last_change_t"] = t
+    if changed:
+        from cometbft_tpu.libs import tracing
+
+        tracing.note_event(
+            "device_probe", up=up, ordinal=ordinal, source=source
+        )
+        # proactive mesh exclusion (a no-op when no mesh is configured or
+        # the ordinal is not a member) — jax-free on both sides
+        from cometbft_tpu.parallel import elastic
+
+        elastic.note_probe(ordinal, up)
     return changed
 
 
@@ -118,13 +161,28 @@ def poll_status_file(path: Optional[str] = None) -> bool:
             if _S["_file_mtime"] == mtime:
                 _S["_file_mtime"] = prev_mtime
         return False
-    return record_probe(
+    changed = record_probe(
         up=bool(doc.get("up")),
         platform=str(doc.get("platform") or ""),
         init_s=doc.get("init_s"),
         source="chipwatch",
         t=doc.get("t"),
     )
+    # optional per-ordinal statuses ({"ordinals": {"2": false, ...}}): a
+    # watcher that can tell WHICH chip of the mesh died flips membership
+    # for just that chip instead of the whole device gauge
+    ords = doc.get("ordinals")
+    if isinstance(ords, dict):
+        for k, v in sorted(ords.items()):
+            try:
+                o = int(k)
+            except (TypeError, ValueError):
+                continue
+            if record_probe(
+                up=bool(v), source="chipwatch", t=doc.get("t"), ordinal=o
+            ):
+                changed = True
+    return changed
 
 
 def snapshot() -> dict:
@@ -144,6 +202,7 @@ def snapshot() -> dict:
             "last_probe_t": _S["last_probe_t"],
             "source": _S["source"],
             "status_file": status_file() or "",
+            "ordinals": {str(k): v for k, v in sorted(_S["ordinals"].items())},
         }
 
 

@@ -50,6 +50,11 @@ def _zero() -> dict:
         "dispatch_hist": {},  # "tier-lanes" -> Histo (wall seconds)
         "shard_hist": {},  # device ordinal (str) -> Histo (wall seconds)
         "verify_hist": Histo(),
+        # elastic mesh supervision (parallel/elastic): width the last
+        # dispatch targeted (0 = mesh inactive), shrink/restore counts
+        "mesh_width": 0,
+        "mesh_shrinks": 0,
+        "mesh_restores": 0,
     }
 
 
@@ -92,6 +97,31 @@ def record_shard_time(
         if h is None:
             h = _STATS["shard_hist"][key] = Histo(DISPATCH_BUCKETS_S)
         h.observe(float(seconds))
+
+
+def record_mesh_width(width: int) -> None:
+    """Width of the elastic mesh's current membership — written by
+    ``parallel/elastic`` on every reconfiguration, rendered as the
+    ``cometbft_crypto_mesh_width`` gauge.  jax-free reads, like all of
+    this module: a scrape must never initialize a backend to learn the
+    mesh shrank."""
+    with _LOCK:
+        _STATS["mesh_width"] = int(width)
+
+
+def record_mesh_shrink() -> None:
+    with _LOCK:
+        _STATS["mesh_shrinks"] += 1
+
+
+def record_mesh_restore() -> None:
+    with _LOCK:
+        _STATS["mesh_restores"] += 1
+
+
+def mesh_width() -> int:
+    with _LOCK:
+        return _STATS["mesh_width"]
 
 
 def record_fused(n_segments: int) -> None:

@@ -516,11 +516,24 @@ def _bisect_enabled() -> bool:
     return os.environ.get("COMETBFT_TPU_SUPERVISOR_BISECT", "1") != "0"
 
 
-def verify_supervised(pubs, msgs, sigs, skip: tuple = ()) -> np.ndarray:
+def verify_supervised(
+    pubs, msgs, sigs, skip: tuple = (), mesh: bool = True
+) -> np.ndarray:
     """The supervised ed25519 batch verify: walk the degradation chain,
     return (n,) bool accept bits.  Cannot raise for infrastructure reasons
-    — the host tier always answers."""
+    — the host tier always answers.
+
+    When the elastic mesh supervisor is active (``parallel/elastic`` —
+    >= 2 configured devices, ``COMETBFT_TPU_MESH_SUPERVISOR`` != 0) the
+    batch shards across the device mesh first; the single-chip chain
+    below is the mesh's own floor (``mesh=False`` is how the elastic path
+    re-enters here at width < 2 without recursing)."""
     pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
+    if mesh and not skip:
+        from cometbft_tpu.parallel import elastic
+
+        if elastic.active() and len(pubs) >= elastic.min_batch():
+            return elastic.verify_elastic(pubs, msgs, sigs)
     n = len(pubs)
     reg = backend_health.registry()
     with tracing.span("verify.batch", n=n) as vsp:

@@ -406,6 +406,47 @@ def prepare_batch(
     return arrays, n, structural
 
 
+_MESH_PROBED = [False]
+
+
+def _maybe_enable_mesh() -> None:
+    """One-time device probe deciding elastic-mesh activation
+    (parallel/elastic): >= 2 devices AND either an all-TPU fleet (the
+    production multi-chip host) or an explicit ``COMETBFT_TPU_MESH=1``
+    (the CPU dry-run / bench harnesses with a forced virtual mesh).
+    Single-chip hosts — and the CI suite's forced 8-device CPU mesh,
+    which is virtual parallelism over two cores, not hardware — keep the
+    exact pre-mesh supervised path.  ``COMETBFT_TPU_MESH=0`` vetoes
+    auto-activation outright; scenarios/tests that configured the mesh
+    explicitly are left untouched."""
+    if _MESH_PROBED[0]:
+        return
+    _MESH_PROBED[0] = True
+    from cometbft_tpu.parallel import elastic
+
+    if not elastic.enabled() or elastic.configured():
+        return
+    force = os.environ.get("COMETBFT_TPU_MESH")
+    if force == "0":
+        return
+    try:
+        devs = jax.devices()
+    except Exception:  # noqa: BLE001 — backend init failed: single-chip
+        return
+    if len(devs) < 2:
+        return
+    if force == "1" or all(d.platform == "tpu" for d in devs):
+        from cometbft_tpu.parallel import mesh as pmesh
+
+        ordinals = pmesh.register_devices(devs)
+        elastic.configure(ordinals)
+
+
+def reset_mesh_probe() -> None:
+    """Forget the one-time activation probe (tests)."""
+    _MESH_PROBED[0] = False
+
+
 def verify_batch(
     pubs: Sequence[bytes], msgs: Sequence[bytes], sigs: Sequence[bytes]
 ) -> np.ndarray:
@@ -415,10 +456,15 @@ def verify_batch(
     watchdog deadline and a device failure degrades down the verified
     chain pallas -> xla -> host instead of raising — accept bits are
     always definitive verdicts, never infrastructure errors in disguise.
-    ``COMETBFT_TPU_SUPERVISOR=0`` restores the raw dispatch below."""
+    On a multi-chip host the supervised path shards across the elastic
+    device mesh first (``parallel/elastic`` — one sick chip loses a lane,
+    not the fleet); ``_maybe_enable_mesh`` below decides activation once
+    per process.  ``COMETBFT_TPU_SUPERVISOR=0`` restores the raw dispatch
+    below."""
     from cometbft_tpu.ops import supervisor
 
     if supervisor.enabled():
+        _maybe_enable_mesh()
         return supervisor.verify_supervised(pubs, msgs, sigs)
     arrays, n, structural = prepare_batch(pubs, msgs, sigs, _min_bucket())
     impl = select_impl()

@@ -123,6 +123,45 @@ def _warm_extra(family: str, lanes: int) -> "dict[str, dict]":
     return bls_g1.warm_kernels(lanes)
 
 
+def mesh_shrink_enabled() -> bool:
+    """``COMETBFT_TPU_WARMBOOT_MESH_SHRINK=1`` opts the warm pass into
+    precompiling the elastic mesh's shrink-ladder executables (default
+    off: each mesh width is a full sharded compile, and single-chip
+    hosts have no ladder to warm).  Implies nothing when the mesh
+    supervisor is off or unconfigured."""
+    return os.environ.get("COMETBFT_TPU_WARMBOOT_MESH_SHRINK", "0") == "1"
+
+
+def mesh_shrink_matrix() -> "list[tuple[int, int]]":
+    """(width, lanes) mesh shapes to warm: the full width AND the first
+    shrink step (N-1) at the smallest padding bucket — the shape the
+    first post-shrink dispatch needs mid-consensus.  Empty when the
+    shrink warm-up is off, the mesh supervisor is off, or fewer than 2
+    devices are configured."""
+    if not mesh_shrink_enabled():
+        return []
+    from cometbft_tpu.parallel import elastic
+
+    if not elastic.enabled() or not elastic.configured():
+        return []
+    n = elastic.total_width()
+    if n < 2:
+        return []
+    from cometbft_tpu.ops import verify as ov
+
+    lanes = ov.bucket_size(1, ov._min_bucket())
+    return [(w, lanes) for w in (n, n - 1) if w >= 2]
+
+
+def _warm_mesh(width: int, lanes: int) -> "dict[str, dict]":
+    """Resolve one shrink-ladder mesh executable (no dispatch) — the
+    monkeypatchable seam, exactly like ``_warm_extra``.  Returns
+    {exec-cache tag: info}."""
+    from cometbft_tpu.parallel import mesh as pmesh
+
+    return pmesh.warm_shrink_shape(width, lanes)
+
+
 def warm_matrix() -> "list[tuple[str, int]]":
     """(backend, bucket) shapes to warm, smallest buckets first so the
     commit-sized shapes (votes, small validator sets) come online before
@@ -270,6 +309,40 @@ def _run_matrices(reg, statuses: dict, dead: set, t0: float) -> dict:
                 key,
                 e,
                 breaker,
+            )
+    # elastic-mesh shrink ladder (COMETBFT_TPU_WARMBOOT_MESH_SHRINK):
+    # precompile the (N, N-1)-width sharded executables at the smallest
+    # bucket so the first post-shrink dispatch meets a resident
+    # executable instead of a cold compile mid-consensus.  Same contract
+    # as every other family: a compile failure is counted and logged,
+    # never wedges boot (no breaker here — no single tier represents the
+    # whole mesh; a genuinely sick chip demotes through its own
+    # mesh_dev* breaker at dispatch time).
+    for width, lanes in mesh_shrink_matrix():
+        key = f"mesh{width}-{lanes}"
+        try:
+            with tracing.span(
+                "warmboot.shape", family="mesh", tier=f"mesh{width}",
+                lanes=lanes,
+            ) as shape_sp:
+                infos = _warm_mesh(width, lanes)
+                shape_sp.set(tags=len(infos))
+            for tag, info in infos.items():
+                status = (
+                    "compiled"
+                    if "compile_s" in info
+                    else str(info.get("exec_cache", "?"))
+                )
+                statuses[tag] = status
+                if not status.startswith(("broken", "disabled")):
+                    warmed += 1
+        except Exception as e:  # noqa: BLE001 — boot never wedges
+            failures += 1
+            statuses.setdefault(key, f"error:{type(e).__name__}")
+            logger.warning(
+                "warm-boot: mesh shrink shape %s failed (%r); continuing",
+                key,
+                e,
             )
     # shapes the collapsed matrix no longer pays, per warmed tier
     tiers = {b for b, _ in warm_matrix()} or {"xla"}

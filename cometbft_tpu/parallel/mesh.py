@@ -57,6 +57,60 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     return Mesh(np.array(devices), (SIG_AXIS,))
 
 
+# -- stable physical ordinals -------------------------------------------------
+#
+# Shard attribution must survive mesh reconfiguration: after a shrink, the
+# surviving chips keep the ordinal they had in the FULL mesh — a
+# ``mesh.shard`` span or ``cometbft_crypto_shard_dispatch_seconds{device=}``
+# series must mean the same physical chip across every width, or a
+# post-shrink outlier would masquerade as a different device.  Ordinals are
+# assigned first-sight; the base registry seeds from ``jax.devices()`` in
+# enumeration order, so on a normal host stable ordinal == device index.
+
+_ORDINAL_BY_KEY: dict = {}  # (platform, id) -> stable ordinal
+_DEVICE_BY_ORDINAL: dict = {}  # stable ordinal -> jax.Device
+
+
+def _ensure_base_registry() -> None:
+    if _ORDINAL_BY_KEY:
+        return
+    try:
+        base = jax.devices()
+    except Exception:  # noqa: BLE001 — backend init failed: first-sight
+        return
+    for d in base:
+        key = (d.platform, d.id)
+        if key not in _ORDINAL_BY_KEY:
+            _ORDINAL_BY_KEY[key] = len(_ORDINAL_BY_KEY)
+            _DEVICE_BY_ORDINAL[_ORDINAL_BY_KEY[key]] = d
+
+
+def register_devices(devices) -> "list[int]":
+    """Assign (or look up) stable physical ordinals for ``devices``;
+    returns them in order."""
+    _ensure_base_registry()
+    out = []
+    for d in devices:
+        key = (d.platform, d.id)
+        o = _ORDINAL_BY_KEY.get(key)
+        if o is None:
+            o = len(_ORDINAL_BY_KEY)
+            _ORDINAL_BY_KEY[key] = o
+            _DEVICE_BY_ORDINAL[o] = d
+        out.append(o)
+    return out
+
+
+def stable_ordinal(device) -> int:
+    """The device's stable physical ordinal, or -1 when it was never
+    registered (sorts last in shard iteration)."""
+    return _ORDINAL_BY_KEY.get((device.platform, device.id), -1)
+
+
+def device_for_ordinal(ordinal: int):
+    return _DEVICE_BY_ORDINAL.get(int(ordinal))
+
+
 def _verify_shard(a_bytes, r_bytes, s_bytes, m_bytes, s_ok, *, impl: str):
     """Per-device body: verify the local shard through the SAME kernel the
     single-chip path selects (Pallas on TPU meshes, XLA elsewhere —
@@ -238,7 +292,14 @@ def pad_to_mesh(arrays: dict, mesh: Mesh) -> dict:
     return out
 
 
-def fetch_sharded(accept, mesh: Mesh, impl: str, lanes: int) -> np.ndarray:
+def fetch_sharded(
+    accept,
+    mesh: Mesh,
+    impl: str,
+    lanes: int,
+    injector=None,
+    watchdog: bool = False,
+) -> np.ndarray:
     """Fetch the sharded accept bits shard-by-shard, one ``mesh.shard``
     child span per device carrying the (device ordinal, lanes-per-shard,
     tier) attribution plus the shard's local accept count — the per-lane
@@ -246,23 +307,71 @@ def fetch_sharded(accept, mesh: Mesh, impl: str, lanes: int) -> np.ndarray:
     outlier shard-fetch latency (and its histogram on
     ``cometbft_crypto_shard_dispatch_seconds{device=}``), not as an opaque
     slow dispatch.  Falls back to a plain global fetch when the result is
-    not shard-addressable (already-fetched arrays, single device)."""
+    not shard-addressable (already-fetched arrays, single device).
+
+    Spans and histogram series are keyed by STABLE physical ordinal
+    (``register_devices``), so a post-shrink mesh never re-numbers the
+    surviving chips; a device missing from the registry records -1 and
+    sorts last.  A per-shard fetch-time exception (the chip died after
+    the dispatch "succeeded" — the fetch is where an async XLA error
+    actually surfaces) raises ``parallel.elastic.ShardFailure`` with the
+    ordinal attached instead of crashing the caller; the elastic
+    supervisor turns that into a shrink.  ``injector`` (per-ordinal fault
+    seam) and ``watchdog`` (shard-level dispatch deadline) are used by
+    the supervised path; the raw path leaves both off."""
     n_dev = int(mesh.devices.size)
     per = lanes // n_dev if n_dev else lanes
     shards = getattr(accept, "addressable_shards", None)
     if not shards or len(shards) != n_dev or per * n_dev != lanes:
         return np.asarray(accept)
-    ordinal = {d.id: i for i, d in enumerate(mesh.devices.flat)}
+    register_devices(mesh.devices.flat)
     out = np.zeros(lanes, dtype=bool)
     for sh in sorted(
-        shards, key=lambda s: ordinal.get(s.device.id, 1 << 30)
+        shards,
+        key=lambda s: (stable_ordinal(s.device) < 0, stable_ordinal(s.device)),
     ):
-        dev = ordinal.get(sh.device.id, -1)
+        dev = stable_ordinal(sh.device)
         t0 = time.perf_counter()
         with tracing.span(
             "mesh.shard", device=dev, lanes=per, tier=impl
         ) as sp:
-            data = np.asarray(sh.data)
+
+            def pull(sh=sh, dev=dev):
+                transform = (
+                    injector(dev, None, None, None)
+                    if injector is not None
+                    else None
+                )
+                data = np.asarray(sh.data)
+                return transform(data) if transform is not None else data
+
+            try:
+                if watchdog:
+                    from cometbft_tpu.ops import supervisor
+
+                    data = supervisor.watchdog_call(
+                        pull,
+                        backend=f"mesh_dev{dev}",
+                        note_anomaly=False,
+                    )
+                else:
+                    data = pull()
+                data = np.asarray(data)
+                if data.shape != (per,) or data.dtype != np.bool_:
+                    from cometbft_tpu.crypto.backend_health import (
+                        BackendOutputError,
+                    )
+
+                    raise BackendOutputError(
+                        f"mesh shard {dev} returned shape {data.shape} "
+                        f"dtype {data.dtype}, want ({per},) bool"
+                    )
+            except Exception as e:  # noqa: BLE001 — a dead chip surfaces
+                # HERE (fetch), after the async dispatch looked fine: a
+                # typed, ordinal-attributed failure instead of a crash
+                from cometbft_tpu.parallel import elastic
+
+                raise elastic.ShardFailure(dev, e) from e
             sp.set(ok=int(data.sum()))
         start = sh.index[0].start or 0
         out[start : start + data.shape[0]] = data
@@ -306,3 +415,110 @@ def verify_batch_sharded(
         host = fetch_sharded(accept, mesh, impl, lanes)
     dispatch_stats.record_dispatch_time(impl, lanes, time.perf_counter() - t0)
     return (host[: len(structural)] & structural)[:n]
+
+
+# -- elastic (supervised) device path ----------------------------------------
+#
+# The jax side of parallel/elastic.py: one mesh attempt over a CHOSEN set
+# of stable ordinals, per-shard fault injection at fetch time, and the
+# shard watchdog — everything that needs a real device in hand.  The
+# shrink ladder, breakers and membership live in elastic.py (jax-free).
+
+
+def dispatch_elastic(
+    ordinals: "Sequence[int]",
+    pubs: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    injector=None,
+) -> np.ndarray:
+    """One supervised mesh dispatch over the devices with the given
+    stable ordinals.  Raises ``parallel.elastic.ShardFailure`` on any
+    ordinal-attributable problem (injected fault, fetch-time error,
+    malformed shard, shard watchdog fire) — the elastic supervisor
+    shrinks and re-dispatches; any other exception means the mesh itself
+    is broken (lowering, collective) and the caller falls to the
+    single-chip chain."""
+    from cometbft_tpu.ops import supervisor
+
+    _ensure_base_registry()
+    devices = [_DEVICE_BY_ORDINAL[int(o)] for o in ordinals]
+    m = Mesh(np.array(devices), (SIG_AXIS,))
+    impl = ov.select_impl(m.devices.flat)
+    arrays, n, structural = ov.prepare_batch(pubs, msgs, sigs)
+    arrays = pad_to_mesh(arrays, m)
+    lanes = arrays["s_ok"].shape[0]
+    dispatch_stats.record_dispatch(lanes, n)
+    seq = dispatch_stats.dispatch_count()
+    t0 = time.perf_counter()
+    with tracing.span(
+        "verify.dispatch",
+        tier=impl,
+        lanes=lanes,
+        n=n,
+        dispatch=seq,
+        mesh=len(devices),
+    ):
+
+        def dispatch():
+            # executable resolution (exec-cache load or AOT compile) runs
+            # INSIDE the watchdog worker, like the single-chip supervised
+            # path: a wedged compile is abandoned like a wedged dispatch
+            call, _ = sharded_verify_call(m, lanes, impl)
+            return call(*device_put_args(arrays, m))
+
+        accept, _ = supervisor.watchdog_call(
+            dispatch, backend="mesh", note_anomaly=False
+        )
+        host = fetch_sharded(
+            accept, m, impl, lanes, injector=injector, watchdog=True
+        )
+    dispatch_stats.record_dispatch_time(impl, lanes, time.perf_counter() - t0)
+    return (host[: len(structural)] & structural)[:n]
+
+
+def run_single_shard(
+    ordinal: int,
+    pubs: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    lanes: int,
+) -> np.ndarray:
+    """One shard's worth of verify work on ONE device — the re-admission
+    probe's dispatch (parallel/elastic._probe_ordinal) when no mesh
+    runner seam is installed.  Deliberately tiny: the smallest padding
+    bucket on the probed device, no collective (a half-dead chip must not
+    be able to wedge a healthy mesh's psum)."""
+    device = _DEVICE_BY_ORDINAL.get(int(ordinal))
+    if device is None:
+        _ensure_base_registry()
+        device = _DEVICE_BY_ORDINAL[int(ordinal)]
+    impl = ov.select_impl([device])
+    arrays, n, structural = ov.prepare_batch(pubs, msgs, sigs)
+    # plain jit (not the AOT cache): jit re-specializes per committed
+    # device, so the probe really exercises the probed chip instead of
+    # whatever device the cached executable was compiled for
+    jitted = ov._bucket_jitted(impl, False)
+    placed = {
+        k: jax.device_put(np.asarray(v), device) for k, v in arrays.items()
+    }
+    accept = np.asarray(jitted(**placed))
+    real = (accept[: len(structural)] & structural)[:n]
+    out = np.zeros(int(lanes) if lanes else n, dtype=bool)
+    out[: min(n, out.shape[0])] = real[: out.shape[0]]
+    return out
+
+
+def warm_shrink_shape(width: int, lanes: int) -> dict:
+    """Precompile the sharded executable for a ``width``-device mesh at
+    the given (pre-mesh-padding) lane count — the warm-boot shrink-ladder
+    satellite (``COMETBFT_TPU_WARMBOOT_MESH_SHRINK``): the first
+    post-shrink dispatch must meet a resident executable, not a cold
+    compile mid-consensus.  Returns the exec-cache info dict."""
+    _ensure_base_registry()
+    devices = [_DEVICE_BY_ORDINAL[o] for o in range(int(width))]
+    m = Mesh(np.array(devices), (SIG_AXIS,))
+    impl = ov.select_impl(m.devices.flat)
+    padded = int(lanes) + (-int(lanes)) % int(width)
+    _, info = sharded_verify_call(m, padded, impl)
+    return {mesh_tag(impl, int(width), padded): dict(info)}

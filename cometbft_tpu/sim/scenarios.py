@@ -295,6 +295,13 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_TXINGEST_QUEUE",
     "COMETBFT_TPU_TXINGEST_BATCH",
     "COMETBFT_TPU_TXINGEST_FLUSH_US",
+    # elastic mesh supervision (parallel/elastic): mesh scenarios force
+    # membership + the shard runner in setup; these knobs ride the same
+    # save/restore as everything else
+    "COMETBFT_TPU_MESH_SUPERVISOR",
+    "COMETBFT_TPU_MESH",
+    "COMETBFT_TPU_MESH_MIN_BATCH",
+    "COMETBFT_TPU_WARMBOOT_MESH_SHRINK",
     # observability knobs: saved/restored for cross-run hygiene only.
     # NOTE the cluster reads the BLACKBOX knobs at construction — before
     # setup hooks run — so a scenario override affects only journals
@@ -419,6 +426,244 @@ def _backend_faults_teardown(cluster: SimCluster) -> None:
 
         _tracing.get_tracer().restore_dump_state(dump_saved)
         cluster._dump_saved = None
+
+
+def _sim_mesh_runner(ordinal, pubs, msgs, sigs, lanes):
+    """Host-backed stand-in for ONE mesh shard (the elastic supervisor's
+    ``set_mesh_runner`` seam): verdict-identical to the sharded kernel by
+    construction — the host ZIP-215 oracle IS its differential oracle —
+    without a real multi-device dispatch the 2-core CI host cannot
+    afford.  Breakers, membership, the shrink ladder, re-admission probes
+    and the FaultyDevice injector all run unchanged above this seam."""
+    from cometbft_tpu.parallel import elastic
+
+    return elastic.host_oracle_runner(ordinal, pubs, msgs, sigs, lanes)
+
+
+SIM_MESH_WIDTH = 4  # virtual chip count the mesh scenarios run on
+
+
+def _mesh_setup(extra_env: Optional[dict] = None, width: int = SIM_MESH_WIDTH):
+    """Backend setup (forced tpu seam, virtual-clock breakers) PLUS an
+    elastic mesh of ``width`` virtual ordinals on the per-shard host
+    oracle.  Threshold 1 for the same reason the brownout scenario uses
+    it: the in-process breaker registry is cluster-shared, so healthy
+    traffic would otherwise keep resetting a sick ordinal's
+    consecutive-failure count."""
+    base = _backend_faults_setup(
+        dict(
+            {
+                "COMETBFT_TPU_BREAKER_THRESHOLD": "1",
+                # sim commits are a handful of signatures; the production
+                # min-batch cutoff would keep them off the mesh path
+                # under test
+                "COMETBFT_TPU_MESH_MIN_BATCH": "1",
+            },
+            **(extra_env or {}),
+        )
+    )
+
+    def setup(cluster: SimCluster) -> None:
+        from cometbft_tpu.ops import device_health
+        from cometbft_tpu.parallel import elastic
+
+        base(cluster)
+        # per-ordinal probe state is process-global like the breakers: a
+        # previous run's down-marks must not swallow this run's flips
+        device_health.reset()
+        elastic.clear()
+        elastic.configure(range(width))
+        elastic.set_mesh_runner(_sim_mesh_runner)
+
+    return setup
+
+
+def _mesh_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu.ops import device_health
+    from cometbft_tpu.parallel import elastic
+
+    elastic.clear()  # drops membership + runner + injector, zeroes width
+    device_health.reset()
+    _backend_faults_teardown(cluster)
+
+
+def _chip_death(s: Scenario) -> list[Action]:
+    """One chip of the virtual mesh dies mid-dispatch and STAYS dead:
+    every later dispatch touching ordinal 2 raises, so the first dispatch
+    after t=5 must shrink the mesh 4->3 and re-dispatch (only the failed
+    dispatch re-runs); the mesh_dev2 breaker opens (threshold 1) and
+    keeps the corpse out of membership, with each elapsed backoff costing
+    exactly one failed one-bucket probe.  At t=8 a chip-watcher-style
+    health probe reports ordinal 1 down too — PROACTIVE exclusion: the
+    chip leaves membership before any dispatch pays a failure to find
+    out; because that chip actually dispatches fine, its next half-open
+    probe re-admits it (the probe dispatch is the arbiter, so a flaky
+    watcher can't permanently cost a lane) while the truly dead ordinal
+    2 stays out.  The fleet keeps committing throughout."""
+
+    def die(c: SimCluster) -> None:
+        from cometbft_tpu.parallel import elastic
+
+        c._log("scenario: mesh ordinal 2 dies (every dispatch raises)")
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("raise", ordinals=(2,))
+        )
+
+    def probe_down(c: SimCluster) -> None:
+        from cometbft_tpu.ops import device_health
+
+        c._log("scenario: health probe reports mesh ordinal 1 down")
+        device_health.record_probe(
+            False, source="chipwatch", t=c.clock.now(), ordinal=1
+        )
+
+    return [
+        Action(5.0, "chip death: mesh ordinal 2", die),
+        Action(8.0, "probe-down: mesh ordinal 1", probe_down),
+    ]
+
+
+def _mesh_brownout(s: Scenario) -> list[Action]:
+    """A flapping chip: ordinal 1 fails in bursts (fail 2 / pass 4,
+    counter-based so the run is deterministic per seed) from t=4 to t=12.
+    The mesh must shrink on each failing burst, the mesh_dev1 breaker
+    must cycle open -> half-open -> closed on the virtual-clock backoff
+    (a pass-phase probe re-admits the chip: ``mesh_restore``), and after
+    t=12 the mesh must settle back at full width — all without a single
+    wrong verdict or a missed commit."""
+
+    def flap(c: SimCluster) -> None:
+        from cometbft_tpu.parallel import elastic
+
+        c._log("scenario: mesh ordinal 1 flapping (fail 2 / pass 4)")
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("flap", ordinals=(1,), fail_n=2, pass_n=4)
+        )
+
+    def stable(c: SimCluster) -> None:
+        from cometbft_tpu.parallel import elastic
+
+        c._log("scenario: mesh ordinal 1 stable again")
+        elastic.clear_fault_injector()
+
+    return [
+        Action(4.0, "mesh brownout: ordinal 1 flaps", flap),
+        Action(12.0, "mesh brownout ends", stable),
+    ]
+
+
+def _mesh_blackout(s: Scenario) -> list[Action]:
+    """Three of the four mesh ordinals die at t=5 (overlapping the
+    composed backend brownout's window): the mesh collapses below width 2
+    and every batch falls into the SINGLE-CHIP chain — which the composed
+    ``_backend_brownout`` is failing on the victim nodes at the same
+    time, so the FULL ladder mesh(4)→3→2→xla→host is exercised in one
+    storm.  At t=10.5 the chips heal; half-open probes re-admit them and
+    the mesh climbs back to full width.  (A single flapping ordinal would
+    never drop the width below 2, leaving the composed single-chip
+    brownout dead code — this generator exists so combined-storm's
+    degradation claim stays true with the mesh in the path.)"""
+
+    def blackout(c: SimCluster) -> None:
+        from cometbft_tpu.parallel import elastic
+
+        c._log("scenario: mesh blackout (ordinals 1, 2, 3 die)")
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("raise", ordinals=(1, 2, 3))
+        )
+
+    def restore(c: SimCluster) -> None:
+        from cometbft_tpu.parallel import elastic
+
+        c._log("scenario: mesh blackout ends")
+        elastic.clear_fault_injector()
+
+    return [
+        Action(5.0, "mesh blackout: 3 of 4 ordinals die", blackout),
+        Action(10.5, "mesh blackout ends", restore),
+    ]
+
+
+def _byzantine_voter(s: Scenario) -> list[Action]:
+    """ROADMAP item 5 follow-up: a LIVE validator equivocates — from
+    t=2 to t=8 the last validator double-signs every non-nil prevote and
+    precommit it broadcasts (a second vote for a fabricated block id,
+    signed with its real key, through the production gossip fabric).
+    Honest nodes must detect the conflict in their vote sets
+    (``ConflictingVoteError`` -> ``report_conflicting_votes``), convert
+    it to ``DuplicateVoteEvidence`` at finalize through the evidence
+    pool's consensus buffer, COMMIT the evidence in a later block, and
+    keep agreement + validator-set invariants green — no crafted
+    evidence anywhere in the path."""
+    byz = s.n_vals - 1
+
+    def start(c: SimCluster) -> None:
+        import hashlib
+
+        from cometbft_tpu.consensus.messages import VoteMessage
+        from cometbft_tpu.types.basic import BlockID, PartSetHeader
+        from cometbft_tpu.types.vote import Vote
+
+        node = c.nodes[byz]
+        if node is None:
+            return
+        orig = node.cs.broadcast_hook
+        priv = c.privs[byz]
+        chain_id = c.gdoc.chain_id
+        c._log(
+            "scenario: node%d turns byzantine (double-signs every vote)"
+            % byz
+        )
+
+        def double(msg):
+            orig(msg)
+            if not isinstance(msg, VoteMessage):
+                return
+            v = msg.vote
+            if v.block_id.is_zero():
+                return
+            # a second vote for a fabricated block at the SAME (height,
+            # round, type) — a real equivocation, deterministically
+            # derived from the honest vote it shadows
+            alt = hashlib.sha256(
+                b"byzantine-fork" + v.block_id.hash
+                + v.height.to_bytes(8, "big") + bytes([v.type_])
+            ).digest()
+            v2 = Vote(
+                type_=v.type_,
+                height=v.height,
+                round_=v.round_,
+                block_id=BlockID(
+                    hash=alt,
+                    part_set_header=PartSetHeader(
+                        total=1, hash=hashlib.sha256(alt + b"p").digest()
+                    ),
+                ),
+                timestamp=v.timestamp,
+                validator_address=v.validator_address,
+                validator_index=v.validator_index,
+            )
+            v2.signature = priv.sign(v2.sign_bytes(chain_id))
+            orig(VoteMessage(v2))
+
+        c._byz_orig = (byz, orig)
+        node.cs.broadcast_hook = double
+
+    def stop(c: SimCluster) -> None:
+        saved = getattr(c, "_byz_orig", None)
+        if saved is None:
+            return
+        idx, orig = saved
+        node = c.nodes[idx]
+        if node is not None:
+            node.cs.broadcast_hook = orig
+            c._log("scenario: node%d honest again" % idx)
+        c._byz_orig = None
+
+    return [
+        Action(2.0, "validator turns byzantine", start),
+        Action(8.0, "byzantine validator stops double-signing", stop),
+    ]
 
 
 def _victims(n_vals: int) -> list[int]:
@@ -1292,26 +1537,86 @@ SCENARIOS: dict[str, Scenario] = {
             teardown=_evidence_teardown,
         ),
         Scenario(
+            "chip-death",
+            "one chip of the 4-wide elastic mesh dies mid-dispatch at "
+            "t=5 and stays dead: the failed dispatch (alone) re-runs on "
+            "the shrunken 3-device mesh, the mesh_dev2 breaker opens and "
+            "keeps the corpse out of membership (each elapsed backoff "
+            "costs one failed one-bucket probe, never a production "
+            "batch), and at t=8 a chip-watcher probe marks ordinal 1 "
+            "down — PROACTIVE exclusion before any dispatch fails; since "
+            "that chip actually dispatches fine, its next half-open "
+            "probe re-admits it (mesh_restore) while the dead chip stays "
+            "out.  The fleet keeps committing throughout, verdicts never "
+            "change, traces byte-identical per seed.  Runs on the "
+            "per-shard host-oracle runner seam",
+            target_height=14,
+            max_time=240.0,
+            actions=_chip_death,
+            setup=_mesh_setup(),
+            teardown=_mesh_teardown,
+        ),
+        Scenario(
+            "mesh-brownout",
+            "a flapping chip: mesh ordinal 1 fails in deterministic "
+            "bursts (fail 2 / pass 4) from t=4 to t=12 — the mesh must "
+            "shrink on failing bursts, the mesh_dev1 breaker must cycle "
+            "open -> half-open -> closed on the virtual-clock backoff "
+            "with a pass-phase probe re-admitting the chip "
+            "(mesh_restore), and the mesh settles back at full width "
+            "after the brownout.  Runs on the per-shard host-oracle "
+            "runner seam",
+            target_height=14,
+            max_time=240.0,
+            actions=_mesh_brownout,
+            setup=_mesh_setup(),
+            teardown=_mesh_teardown,
+        ),
+        Scenario(
+            "byzantine-voter",
+            "one LIVE validator double-signs every non-nil prevote and "
+            "precommit from t=2 to t=8 (a second vote for a fabricated "
+            "block id, signed with its real key, through the production "
+            "gossip fabric — no crafted evidence): honest nodes must "
+            "detect the equivocation in their vote sets, convert it to "
+            "DuplicateVoteEvidence at finalize, commit it, and hold "
+            "agreement + validator-set invariants, byte-deterministic "
+            "per seed",
+            target_height=12,
+            max_time=240.0,
+            actions=_byzantine_voter,
+        ),
+        Scenario(
             "combined-storm",
             "the composition layer's proof: minority partition + device "
             "backend brownout on f+1 nodes + scripted bulk verify bursts "
-            "run in ONE script (compose()).  Agreement must hold, only "
-            "bulk-class verify work may shed, and the supervisor must "
-            "degrade and re-promote exactly as in the single-fault runs",
+            "+ a mesh blackout (3 of 4 ordinals die t=5..10.5, so the "
+            "mesh collapses below width 2 and the single-chip brownout "
+            "REALLY fires underneath it) composed in ONE script "
+            "(compose()).  Agreement must hold, only bulk-class verify "
+            "work may shed, the full ladder mesh(4)->...->xla->host must "
+            "degrade and every layer must re-promote after the storm",
             target_height=14,
             max_time=300.0,
             actions=compose(
-                _partition_minority, _backend_brownout, _gossip_burst
+                _partition_minority,
+                _backend_brownout,
+                _gossip_burst,
+                _mesh_blackout,
             ),
-            setup=_backend_faults_setup(
+            setup=_mesh_setup(
                 {
                     "COMETBFT_TPU_VERIFY_SCHED": "1",
                     "COMETBFT_TPU_SCHED_QUEUE": "48",
                     "COMETBFT_TPU_SCHED_FLUSH_US": "500",
-                    "COMETBFT_TPU_BREAKER_THRESHOLD": "1",
+                    # failed probes during the blackout double each dead
+                    # chip's backoff; cap it low so re-admission probes
+                    # recur fast enough to restore full width before the
+                    # run ends (deterministic: virtual clock)
+                    "COMETBFT_TPU_BREAKER_BACKOFF_MAX_MS": "2000",
                 }
             ),
-            teardown=_backend_faults_teardown,
+            teardown=_mesh_teardown,
         ),
         Scenario(
             "backend-flap",
@@ -1436,6 +1741,15 @@ def run_scenario(
                     n: b["state"] for n, b in snap["breakers"].items()
                 },
             }
+            # elastic-mesh shape of the run (chip-death / mesh-brownout /
+            # combined-storm): width at end of run + shrink/restore
+            # counts — only when the mesh actually ran, so non-mesh
+            # backend rows don't grow dead columns
+            msnap = _dstats.snapshot()
+            if msnap["mesh_width"] or msnap["mesh_shrinks"]:
+                backend_stats["mesh_width"] = msnap["mesh_width"]
+                backend_stats["mesh_shrinks"] = msnap["mesh_shrinks"]
+                backend_stats["mesh_restores"] = msnap["mesh_restores"]
             # only when the scenario ran with the scheduler enabled —
             # backend-* scenarios pin it off, and an all-zero sched block
             # in their soak rows would read as "scheduler ran, idle"

@@ -347,8 +347,12 @@ class VerifyScheduler:
     def _bucket_target(self) -> int:
         """Items that fill the smallest padding bucket for the active
         kernel: flushing there costs zero padding waste, so waiting any
-        longer only adds latency.  Computed once, off the submit path (the
-        ops import pulls in jax)."""
+        longer only adds latency.  The base bucket is computed once, off
+        the submit path (the ops import pulls in jax); the LIVE elastic
+        mesh width scales it per flush — a W-device mesh splits the batch
+        W ways, so a full flush is W smallest buckets (one per shard),
+        and the target follows shrinks and restores automatically
+        (``parallel/elastic.healthy_width`` is jax-free)."""
         if self._full_target is None:
             try:
                 from cometbft_tpu.ops import verify as ov
@@ -356,7 +360,30 @@ class VerifyScheduler:
                 self._full_target = ov.bucket_size(1, ov._min_bucket())
             except Exception:  # noqa: BLE001 — conservative fallback
                 self._full_target = 128
-        return self._full_target
+        try:
+            from cometbft_tpu.parallel import elastic
+
+            w = elastic.healthy_width()
+        except Exception:  # noqa: BLE001 — mesh introspection is never
+            # load-bearing for the flush loop
+            w = 0
+        if w < 2:
+            return self._full_target
+        # round DOWN to a real padding bucket: the mesh path pads the
+        # fused batch to a GLOBAL bucket before sharding, so a non-bucket
+        # target (base×3 = 384 → bucket 512) would deliberately wait for
+        # a strictly worse-padded flush; the largest bucket ≤ base×W
+        # keeps the zero-waste property at lower latency
+        scaled = self._full_target * w
+        try:
+            from cometbft_tpu.ops import verify as ov
+
+            fits = [
+                b for b in ov._BUCKETS if self._full_target <= b <= scaled
+            ]
+            return fits[-1] if fits else self._full_target
+        except Exception:  # noqa: BLE001
+            return scaled
 
     def _oldest_t0(self) -> Optional[float]:
         heads = [q[0].t0 for q in self._queues if q]
@@ -374,7 +401,7 @@ class VerifyScheduler:
         return out
 
     def _run(self) -> None:
-        full = self._bucket_target()  # jax import happens here, unlocked
+        self._bucket_target()  # jax import happens here, unlocked
         # the dispatcher only exists when the trusted backend is active —
         # the exact population warm-boot serves: precompile the bucket x
         # tier matrix in the background so the first flush (and the first
@@ -383,6 +410,11 @@ class VerifyScheduler:
 
         warmboot.ensure_started()
         while True:
+            # re-read once per flush cycle (not per wakeup: every submit
+            # notifies the cond, and the live-width read walks breaker
+            # locks) — the target still follows mesh shrinks/restores at
+            # flush granularity
+            full = self._bucket_target()
             with self._cond:
                 while not self._stopped and (
                     self._count == 0 or self._paused

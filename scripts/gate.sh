@@ -21,34 +21,43 @@ cd "$(dirname "$0")/.."
 # gate run compiled, instead of re-tracing per process.
 export COMETBFT_TPU_EXEC_CACHE="${COMETBFT_TPU_EXEC_CACHE:-$PWD/.exec_cache}"
 
-echo "== gate 1/7: verify call-site lint =="
+echo "== gate 1/8: verify call-site lint =="
 python scripts/check_verify_callsites.py
 
-echo "== gate 2/7: pytest =="
+echo "== gate 2/8: pytest =="
 rm -f /tmp/_gate_t1.log
 python -m pytest tests/ -x -q --durations=40 2>&1 | tee /tmp/_gate_t1.log
 python scripts/check_tier1_budget.py /tmp/_gate_t1.log
 
-echo "== gate 3/7: bench.py =="
+echo "== gate 3/8: bench.py =="
 python bench.py
 
-echo "== gate 4/7: bench trend (BENCH_HISTORY.jsonl) =="
+echo "== gate 4/8: bench.py --meshfault (elastic mesh fault isolation) =="
+# healthy vs one-dead-chip dispatch on the per-shard host-oracle seam:
+# verdict equality, exactly one shrink, dispatch counts asserted hard;
+# refreshes BENCH_MESHFAULT.json for the trend gate below
+JAX_PLATFORMS=cpu python bench.py --meshfault
+
+echo "== gate 5/8: bench trend (BENCH_HISTORY.jsonl) =="
 # re-ingests every BENCH_*.json + sim_soak trend JSON and fails on hard
 # regressions (dispatch counts, cache/occupancy ratios) beyond the noise
 # band; wall/throughput deltas stay advisory on this throttled host
 python scripts/bench_trend.py --check
 
-echo "== gate 5/7: SIGKILL forensics (black-box postmortem) =="
+echo "== gate 6/8: SIGKILL forensics (black-box postmortem) =="
 # crash a sim validator mid-round, decode its journal with the real
 # `cometbft-tpu postmortem --json` subprocess, assert the reconstructed
 # in-flight round + dispatch attribution, byte-deterministic per seed
 JAX_PLATFORMS=cpu python scripts/check_postmortem.py
 
-echo "== gate 6/7: dryrun_multichip(8) =="
+echo "== gate 7/8: dryrun_multichip(8) + elastic fault leg =="
+# includes the chip-death leg: one ordinal killed mid-run, the batch
+# must re-verify on the shrunken mesh with correct ordinal attribution
+# (COMETBFT_TPU_DRYRUN_FAULT=0 skips the leg)
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== gate 7/7: native sanitizers (TSAN+ASAN) =="
+echo "== gate 8/8: native sanitizers (TSAN+ASAN) =="
 bash scripts/sanitize_native.sh
 
 if [ "${NIGHTLY:-0}" = "1" ]; then

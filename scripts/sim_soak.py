@@ -58,6 +58,14 @@ def _row_extra(row: dict) -> str:
             backend.get("watchdog_fires", 0),
             backend.get("breaker_opens", 0),
         )
+    if backend.get("mesh_width") or backend.get("mesh_shrinks"):
+        # elastic-mesh scenarios: the degradation shape at a glance —
+        # final width plus how many times the mesh shrank and healed
+        extra += " mesh=%dw shrink=%d restore=%d" % (
+            backend.get("mesh_width", 0),
+            backend.get("mesh_shrinks", 0),
+            backend.get("mesh_restores", 0),
+        )
     ingest = row.get("ingest") or {}
     if ingest:
         # tx-flood: admission shape is the at-a-glance verdict — batched

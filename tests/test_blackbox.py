@@ -348,6 +348,50 @@ class TestPostmortem:
         }
         assert [s["stage"] for s in rep["open_spans"]] == ["consensus.round"]
 
+    def test_mesh_width_at_death(self, tmp_path):
+        """ISSUE 13: the postmortem reports the elastic mesh's width at
+        death — the last mesh.reconfig event of the final incarnation —
+        plus the membership events, and a mesh dispatch's last_dispatch
+        carries the width it targeted."""
+        j = _mkjournal(tmp_path)
+        j.on_event("boot", {"node": 0})
+        # a previous incarnation's mesh state must NOT leak forward
+        j.on_event("mesh.reconfig", {"width": 8, "reason": "configure"})
+        j.on_event("boot", {"node": 0})
+        j.on_event("mesh.reconfig", {"width": 4, "reason": "configure"})
+        j.append(blackbox.REC_SPAN, {
+            "stage": "verify.dispatch", "span": 5, "trace": 5,
+            "t0": 1.0, "t1": 1.2, "dur_ms": 200.0,
+            "attrs": {"tier": "xla", "lanes": 128, "n": 100,
+                      "dispatch": 3, "mesh": 4},
+        })
+        j.on_event("mesh.reconfig", {
+            "width": 3, "excluded": 2, "reason": "shard-failure",
+        })
+        j.kill()
+        rep = blackbox.postmortem_report(j.dir)
+        assert rep["mesh"]["width"] == 3
+        reasons = [
+            (e["attrs"].get("reason"), e["attrs"].get("width"))
+            for e in rep["mesh"]["events"]
+        ]
+        assert reasons == [("configure", 4), ("shard-failure", 3)]
+        assert rep["mesh"]["events"][-1]["attrs"]["excluded"] == 2
+        assert rep["last_dispatch"]["mesh"] == 4
+
+    def test_single_chip_report_has_no_mesh_width(self, tmp_path):
+        j = _mkjournal(tmp_path)
+        j.on_event("boot", {"node": 0})
+        j.append(blackbox.REC_SPAN, {
+            "stage": "verify.dispatch", "span": 5, "trace": 5,
+            "t0": 1.0, "t1": 1.2, "dur_ms": 200.0,
+            "attrs": {"tier": "xla", "lanes": 32, "n": 8, "dispatch": 1},
+        }, sync=j.SYNC_FLUSH)
+        j.kill()
+        rep = blackbox.postmortem_report(j.dir)
+        assert rep["mesh"] == {"width": None, "events": []}
+        assert "mesh" not in rep["last_dispatch"]
+
     def test_boot_event_retires_previous_incarnations_opens(self, tmp_path):
         """An unfinished round OPEN from a crashed run must not read as
         'open at death' of the NEXT incarnation: its process is gone."""

@@ -258,3 +258,382 @@ class TestMeshPallasComposition:
         finally:
             pv._build.cache_clear()
             pmesh._FN_CACHE.clear()
+
+
+# ----------------------------------------------------------------------
+# elastic mesh supervision (ISSUE 13: per-shard fault isolation)
+# ----------------------------------------------------------------------
+
+
+class TestElasticMesh:
+    """The shrink ladder on the per-shard host-oracle runner seam: every
+    injected fault mode at every ordinal must yield verdicts bitwise-equal
+    to the host ZIP-215 oracle (infrastructure failures NEVER become wrong
+    verdicts), shrinks must attribute to the right stable ordinal, and the
+    breaker machinery must exclude/re-admit deterministically."""
+
+    WIDTH = 4
+
+    @pytest.fixture(autouse=True)
+    def _elastic_mesh(self, monkeypatch):
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.libs import tracing
+        from cometbft_tpu.ops import device_health, dispatch_stats
+        from cometbft_tpu.parallel import elastic
+
+        monkeypatch.setenv("COMETBFT_TPU_BREAKER_THRESHOLD", "1")
+        monkeypatch.delenv("COMETBFT_TPU_MESH_SUPERVISOR", raising=False)
+        backend_health.reset()
+        device_health.reset()
+        tracing.reset_tracer()
+        dispatch_stats.reset()
+        elastic.clear()
+        elastic.configure(range(self.WIDTH))
+        elastic.set_mesh_runner(self._oracle_runner)
+        yield
+        elastic.clear()
+        device_health.reset()
+        backend_health.reset()
+        tracing.reset_tracer()
+        dispatch_stats.reset()
+
+    @staticmethod
+    def _oracle_runner(ordinal, pubs, msgs, sigs, lanes):
+        from cometbft_tpu.parallel import elastic
+
+        return elastic.host_oracle_runner(ordinal, pubs, msgs, sigs, lanes)
+
+    @staticmethod
+    def _mixed_batch(seed: int, n: int):
+        import random
+
+        rng = random.Random(seed)
+        pubs, msgs, sigs = [], [], []
+        expected = np.zeros(n, dtype=bool)
+        for i in range(n):
+            s = bytes([(seed + i) % 255 + 1]) * 32
+            pub = ref.pubkey_from_seed(s)
+            msg = b"elastic-%d-%d" % (seed, i)
+            sig = ref.sign(s, msg)
+            roll = rng.random()
+            if roll < 0.2:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])  # forged
+            elif roll < 0.3:
+                sig = bytes(64)  # degenerate
+            elif roll < 0.35:
+                pub = pub[:16]  # structurally invalid
+            pubs.append(pub)
+            msgs.append(msg)
+            sigs.append(sig)
+            expected[i] = (
+                len(pub) == 32
+                and len(sig) == 64
+                and ref.verify_zip215(pub, msg, sig)
+            )
+        return pubs, msgs, sigs, expected
+
+    def test_fault_matrix_every_mode_every_ordinal(self, monkeypatch):
+        """raise / wrong_shape / flap at EVERY ordinal: verdicts stay
+        bitwise-equal to the host oracle, the failure attributes to the
+        injected ordinal's breaker, and the mesh shrinks exactly once per
+        dead chip (the open breaker excludes it thereafter)."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.parallel import elastic
+
+        pubs, msgs, sigs, expected = self._mixed_batch(7, 23)
+        for mode in ("raise", "wrong_shape", "flap"):
+            for ordinal in range(self.WIDTH):
+                backend_health.reset()
+                elastic.set_fault_injector(
+                    elastic.FaultyDevice(
+                        mode, ordinals=(ordinal,), fail_n=2, pass_n=1
+                    )
+                )
+                bits = elastic.verify_elastic(pubs, msgs, sigs)
+                assert (bits == expected).all(), (mode, ordinal)
+                st = backend_health.registry().breaker(
+                    f"mesh_dev{ordinal}"
+                ).stats()
+                assert st["failures_total"] >= 1, (mode, ordinal, st)
+                elastic.clear_fault_injector()
+
+    def test_hang_mode_shard_watchdog_fires(self, monkeypatch):
+        """A wedged shard: the shard watchdog abandons it, the anomaly
+        taxonomy records shard_watchdog_fire with the ordinal, and the
+        verdicts still match the oracle."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.libs import tracing
+        from cometbft_tpu.parallel import elastic
+
+        monkeypatch.setenv("COMETBFT_TPU_DISPATCH_TIMEOUT_MS", "60")
+        pubs, msgs, sigs, expected = self._mixed_batch(11, 17)
+        for ordinal in range(self.WIDTH):
+            backend_health.reset()
+            elastic.set_fault_injector(
+                elastic.FaultyDevice("hang", ordinals=(ordinal,), hang_s=0.3)
+            )
+            bits = elastic.verify_elastic(pubs, msgs, sigs)
+            assert (bits == expected).all(), ordinal
+            elastic.clear_fault_injector()
+        snap = tracing.get_tracer().snapshot()
+        # the tracer survives the per-ordinal backend_health resets, so
+        # it saw every ordinal's fire; the registry counter only keeps
+        # the last iteration's
+        assert snap["anomalies"].get("shard_watchdog_fire", 0) >= self.WIDTH
+        assert backend_health.snapshot()["watchdog_fires"] >= 1
+
+    def test_uneven_batch_with_dead_device(self):
+        """Uneven shards (n not a multiple of the width) + a proactively
+        dead device: membership drops to 3 BEFORE the dispatch (no shrink
+        anomaly — the breaker was already open) and verdicts match."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.libs import tracing
+        from cometbft_tpu.ops import dispatch_stats
+        from cometbft_tpu.parallel import elastic
+
+        backend_health.registry().breaker("mesh_dev3").trip("pre-dead")
+        pubs, msgs, sigs, expected = self._mixed_batch(13, 19)
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        assert dispatch_stats.mesh_width() == self.WIDTH - 1
+        spans = tracing.get_tracer().tail(0)
+        shard_devs = sorted(
+            s["attrs"]["device"] for s in spans if s["stage"] == "mesh.shard"
+        )
+        assert shard_devs == [0, 1, 2]  # stable ordinals, 3 excluded
+        assert not any(
+            s["stage"] == "verify.dispatch" and s["attrs"].get("error")
+            for s in spans
+        )
+
+    def test_shrink_then_restore_round_trip(self, monkeypatch):
+        """Kill ordinal 1, dispatch (shrink), heal it, advance the fake
+        clock past the backoff: the next dispatch's membership probes the
+        HALF_OPEN breaker with a one-bucket dispatch, re-admits the chip
+        (mesh_restore), and the width returns to full — verdicts equal to
+        the oracle at every step."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.libs import tracing
+        from cometbft_tpu.ops import dispatch_stats
+        from cometbft_tpu.parallel import elastic
+
+        fake = [100.0]
+        backend_health.reset()
+        backend_health.registry().set_clock(lambda: fake[0])
+        pubs, msgs, sigs, expected = self._mixed_batch(17, 21)
+
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("raise", ordinals=(1,))
+        )
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        assert dispatch_stats.mesh_width() == self.WIDTH - 1
+        snap = dispatch_stats.snapshot()
+        assert snap["mesh_shrinks"] == 1
+
+        # still dead: the elapsed backoff costs one failed PROBE, never a
+        # production batch, and the backoff doubles
+        fake[0] += 5.0
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        assert dispatch_stats.mesh_width() == self.WIDTH - 1
+        st = backend_health.registry().breaker("mesh_dev1").stats()
+        assert st["probes"] >= 1
+
+        # healed: the next backoff window's probe passes and re-admits
+        elastic.clear_fault_injector()
+        fake[0] += 10.0
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        snap = dispatch_stats.snapshot()
+        assert snap["mesh_width"] == self.WIDTH
+        assert snap["mesh_restores"] == 1
+        st = backend_health.registry().breaker("mesh_dev1").stats()
+        assert st["state"] == "closed"
+        assert st["repromotions"] == 1
+        anomalies = tracing.get_tracer().snapshot()["anomalies"]
+        assert anomalies.get("mesh_shrink", 0) >= 1
+        assert anomalies.get("mesh_restore", 0) == 1
+
+    def test_probe_down_proactive_exclusion(self):
+        """An ops/device_health down-probe for an ordinal removes it from
+        membership BEFORE the next dispatch (breaker tripped, mesh_shrink
+        anomaly with reason=probe-down) — no dispatch pays a failure."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.libs import tracing
+        from cometbft_tpu.ops import device_health, dispatch_stats
+        from cometbft_tpu.parallel import elastic
+
+        changed = device_health.record_probe(
+            False, source="chipwatch", ordinal=2
+        )
+        assert changed
+        st = backend_health.registry().breaker("mesh_dev2").stats()
+        assert st["state"] == "open"
+        pubs, msgs, sigs, expected = self._mixed_batch(19, 9)
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        assert dispatch_stats.mesh_width() == self.WIDTH - 1
+        anomalies = tracing.get_tracer().snapshot()["anomalies"]
+        assert anomalies.get("mesh_shrink", 0) == 1
+        # per-ordinal state surfaces in the forensic document
+        assert device_health.snapshot()["ordinals"] == {"2": False}
+        # a repeated identical probe is not a transition
+        assert not device_health.record_probe(
+            False, source="chipwatch", ordinal=2
+        )
+
+    def test_probe_down_before_configure_still_excludes(self):
+        """A chip the watcher marked down BEFORE the mesh was configured
+        (boot-time outage) must not join membership: configure() folds
+        the recorded per-ordinal health state in."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.ops import device_health, dispatch_stats
+        from cometbft_tpu.parallel import elastic
+
+        elastic.clear()
+        backend_health.reset()
+        device_health.reset()
+        device_health.record_probe(False, source="chipwatch", ordinal=1)
+        elastic.configure(range(self.WIDTH))
+        elastic.set_mesh_runner(self._oracle_runner)
+        st = backend_health.registry().breaker("mesh_dev1").stats()
+        assert st["state"] == "open", st
+        pubs, msgs, sigs, expected = self._mixed_batch(43, 11)
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        assert dispatch_stats.mesh_width() == self.WIDTH - 1
+
+    def test_all_ordinals_dead_falls_to_single_chip_chain(self):
+        """Width < 2 is the bottom of the ladder: the batch resolves on
+        the existing single-chip supervised chain (here the device-runner
+        seam), still bitwise the oracle."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.ops import supervisor
+        from cometbft_tpu.parallel import elastic
+
+        for o in range(1, self.WIDTH):
+            backend_health.registry().breaker(f"mesh_dev{o}").trip("dead")
+
+        supervisor.set_device_runner(elastic.host_oracle_runner)
+        try:
+            pubs, msgs, sigs, expected = self._mixed_batch(23, 13)
+            bits = elastic.verify_elastic(pubs, msgs, sigs)
+            assert (bits == expected).all()
+        finally:
+            supervisor.clear_device_runner()
+
+    def test_kill_switch_bitwise_parity(self, monkeypatch):
+        """COMETBFT_TPU_MESH_SUPERVISOR=0: the supervised path must not
+        touch the mesh at all — verdicts come from the single-chip chain
+        bit-for-bit, and elastic reports inactive."""
+        from cometbft_tpu.ops import supervisor
+        from cometbft_tpu.parallel import elastic
+
+        pubs, msgs, sigs, expected = self._mixed_batch(29, 15)
+
+        supervisor.set_device_runner(elastic.host_oracle_runner)
+        monkeypatch.setenv("COMETBFT_TPU_MESH_MIN_BATCH", "1")
+        try:
+            with_mesh = supervisor.verify_supervised(pubs, msgs, sigs)
+            monkeypatch.setenv("COMETBFT_TPU_MESH_SUPERVISOR", "0")
+            assert not elastic.active()
+            without = supervisor.verify_supervised(pubs, msgs, sigs)
+        finally:
+            supervisor.clear_device_runner()
+        assert (with_mesh == expected).all()
+        assert (without == expected).all()
+        assert (with_mesh == without).all()
+
+    def test_min_batch_cutoff_keeps_small_batches_single_chip(
+        self, monkeypatch
+    ):
+        """The production routing only meshes batches past
+        COMETBFT_TPU_MESH_MIN_BATCH: a handful of gossip-vote signatures
+        must not pay a cross-device dispatch — they stay on the
+        single-chip chain (verdicts identical either way)."""
+        from cometbft_tpu.libs import tracing
+        from cometbft_tpu.ops import supervisor
+        from cometbft_tpu.parallel import elastic
+
+        monkeypatch.setenv("COMETBFT_TPU_MESH_MIN_BATCH", "16")
+        supervisor.set_device_runner(elastic.host_oracle_runner)
+        try:
+            small = self._mixed_batch(37, 8)
+            bits = supervisor.verify_supervised(*small[:3])
+            assert (bits == small[3]).all()
+            spans = tracing.get_tracer().tail(0)
+            assert not any(s["stage"] == "mesh.shard" for s in spans)
+            big = self._mixed_batch(41, 16)
+            bits = supervisor.verify_supervised(*big[:3])
+            assert (bits == big[3]).all()
+            spans = tracing.get_tracer().tail(0)
+            assert any(s["stage"] == "mesh.shard" for s in spans)
+        finally:
+            supervisor.clear_device_runner()
+
+    def test_width_gauge_and_metrics_exposition(self):
+        from cometbft_tpu.libs.metrics import NodeMetrics
+        from cometbft_tpu.parallel import elastic
+
+        pubs, msgs, sigs, expected = self._mixed_batch(31, 8)
+        bits = elastic.verify_elastic(pubs, msgs, sigs)
+        assert (bits == expected).all()
+        text = NodeMetrics().registry.expose()
+        assert "cometbft_crypto_mesh_width 4" in text
+        assert "cometbft_crypto_mesh_shrinks" in text
+        assert "cometbft_crypto_mesh_restores" in text
+
+    def test_sched_bucket_target_follows_live_width(self):
+        """The verifysched flush target scales with the live mesh width
+        (a W-device mesh fills W smallest buckets per flush) and falls
+        back to the single-chip target when the mesh is inactive."""
+        from cometbft_tpu import verifysched
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.parallel import elastic
+
+        from cometbft_tpu.ops import verify as ov
+
+        sched = verifysched.VerifyScheduler()
+        # width 4 (a power of two): base×4 is itself a bucket
+        full = sched._bucket_target()
+        base = ov.bucket_size(1, ov._min_bucket())
+        assert full == base * self.WIDTH
+        # width 3: base×3 is NOT a bucket — the target rounds DOWN to the
+        # largest real bucket (the mesh path pads to a global bucket, so
+        # waiting for a non-bucket count would flush worse-padded)
+        backend_health.registry().breaker("mesh_dev0").trip("dead")
+        want = max(b for b in ov._BUCKETS if base <= b <= base * 3)
+        assert sched._bucket_target() == want
+        elastic.clear()
+        assert sched._bucket_target() == base
+
+    def test_warmboot_mesh_shrink_matrix(self, monkeypatch):
+        """COMETBFT_TPU_WARMBOOT_MESH_SHRINK=1 warms the (N, N-1)
+        smallest-bucket mesh shapes through the monkeypatchable seam;
+        off (default) or mesh-supervisor-off skips them entirely."""
+        from cometbft_tpu.ops import warmboot
+
+        warmed = []
+
+        def fake_warm(width, lanes):
+            warmed.append((width, lanes))
+            return {f"mesh-xla-{width}dev-{lanes}": {"exec_cache": "hit"}}
+
+        monkeypatch.setattr(warmboot, "_warm_mesh", fake_warm)
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
+
+        assert warmboot.mesh_shrink_matrix() == []  # default off
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_MESH_SHRINK", "1")
+        matrix = warmboot.mesh_shrink_matrix()
+        assert [w for w, _ in matrix] == [self.WIDTH, self.WIDTH - 1]
+        report = warmboot.run()
+        assert warmed == matrix
+        assert any(k.startswith("mesh-xla-4dev") for k in report["statuses"])
+        assert any(k.startswith("mesh-xla-3dev") for k in report["statuses"])
+
+        # kill switch: the mesh supervisor being off empties the matrix
+        monkeypatch.setenv("COMETBFT_TPU_MESH_SUPERVISOR", "0")
+        assert warmboot.mesh_shrink_matrix() == []

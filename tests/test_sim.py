@@ -693,11 +693,14 @@ class TestFleetScale:
         assert s["submitted"]["evidence_light"] > 0, s
         assert s["shed"]["consensus"] == 0, s
 
-    def test_combined_storm_composes_three_faults(self, tmp_path):
+    def test_combined_storm_composes_four_faults(self, tmp_path):
         """ISSUE acceptance: partition + backend brownout + gossip burst
-        in ONE script (compose()) — agreement holds, consensus-class
-        verify shed is 0, only bulk sheds, and the supervisor degrades and
-        re-promotes as in the single-fault scenarios."""
+        + a mesh blackout in ONE script (compose()) — agreement holds,
+        consensus-class verify shed is 0, only bulk sheds, and the FULL
+        ladder degrades: the mesh collapses below width 2 (3 shrinks), so
+        the single-chip brownout underneath really fires (xla breaker
+        opens, host fallback carries signatures), and every layer
+        re-promotes after the storm."""
         res = run_scenario(
             "combined-storm", 3, root=tmp_path, raise_on_violation=True
         )
@@ -710,6 +713,19 @@ class TestFleetScale:
         b = res.backend
         assert b["demotions"] >= 1, b
         assert b["repromotions"] >= 1, b
+        # the mesh blackout really collapsed the mesh (one shrink per
+        # dead ordinal) and every chip was probe-re-admitted after it
+        assert b["mesh_shrinks"] >= 3, b
+        assert b["mesh_restores"] >= 3, b
+        assert b["mesh_width"] == 4, b
+        # ... which means the single-chip chain REALLY ran under the
+        # composed brownout: the xla breaker opened and the host tier
+        # carried real signatures (the composed fault is not dead code)
+        assert res.spans["anomalies"].get("breaker_open", 0) >= 1
+        assert b["fallback_signatures"] > 0, b
+        assert b["breakers"]["xla"] == "closed", b  # re-promoted
+        assert res.spans["anomalies"].get("mesh_shrink", 0) >= 3
+        assert res.spans["anomalies"].get("mesh_restore", 0) >= 3
         # the partition really happened too
         assert any("partition minority" in l for l in res.trace)
 
@@ -884,3 +900,174 @@ class TestRotationEdgeCases:
         cluster.checker.on_event(cluster)
         kinds = {v.invariant for v in cluster.checker.violations}
         assert "validator-set" in kinds, cluster.checker.violations
+
+
+# ----------------------------------------------------------------------
+# elastic mesh fault scenarios (ISSUE 13: per-shard fault isolation)
+# ----------------------------------------------------------------------
+
+
+class TestMeshFaultScenarios:
+    """Chip-level faults on the 4-wide virtual mesh must cost a lane,
+    never the fleet: the failed dispatch alone re-runs on the shrunken
+    mesh, breakers exclude/re-admit deterministically on the virtual
+    clock, verdicts never change, and the whole story lands on the
+    observability rails (anomaly kinds, dumps, journal events)."""
+
+    def test_chip_death_fleet_keeps_committing(self, tmp_path, monkeypatch):
+        """ISSUE acceptance: a chip dies mid-dispatch at a scripted time;
+        the fleet keeps committing, exactly one shrink re-runs the failed
+        dispatch, the breaker attributes the death to the right ordinal,
+        and the anomaly dump's header names that ordinal."""
+        import json as _json
+
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")  # dump asserts below
+        res = run_scenario(
+            "chip-death", 3, root=tmp_path, raise_on_violation=True,
+            keep_cluster=True,
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        b = res.backend
+        # the dead chip's dispatch failure + its failed re-admission
+        # probes all attribute to mesh_dev2; the probe-marked ordinal 1
+        # was excluded proactively and re-admitted by a passing probe
+        assert b["breakers"]["mesh_dev2"] in ("open", "half-open"), b
+        assert b["mesh_shrinks"] >= 2, b  # the death + the probe-down
+        assert b["mesh_restores"] >= 1, b  # ordinal 1 came back
+        assert b["mesh_width"] == 3, b  # only the corpse stays out
+        anomalies = res.spans["anomalies"]
+        assert anomalies.get("mesh_shrink", 0) >= 2, anomalies
+        assert anomalies.get("mesh_restore", 0) >= 1, anomalies
+        assert anomalies.get("breaker_open_mesh_dev2", 0) >= 1, anomalies
+        assert anomalies.get("breaker_open_mesh_dev1", 0) == 1, anomalies
+        # the mesh_shrink dump attributes the death to ordinal 2
+        dump = next(
+            d["file"] for d in res.spans["dumps"]
+            if d["file"].endswith("mesh_shrink.jsonl")
+        )
+        lines = [
+            _json.loads(l) for l in open(tmp_path / "flight" / dump)
+        ]
+        assert lines[0]["anomaly"] == "mesh_shrink"
+        assert lines[0]["attrs"]["ordinal"] == 2
+        assert lines[0]["attrs"]["width"] == 3
+        # the failed shard span is in the dump, keyed by stable ordinal
+        failed = [
+            s for s in lines[1:]
+            if s["stage"] == "mesh.shard" and s["attrs"].get("error")
+        ]
+        assert failed and failed[-1]["attrs"]["device"] == 2
+        res.cluster.stop()
+
+    def test_mesh_brownout_shrinks_and_restores(self, tmp_path, monkeypatch):
+        """A flapping chip: the breaker must cycle open -> half-open ->
+        closed on the virtual-clock backoff, with pass-phase probes
+        re-admitting the chip, and the mesh must settle at full width."""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")
+        res = run_scenario(
+            "mesh-brownout", 3, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        b = res.backend
+        assert b["mesh_shrinks"] >= 1, b
+        assert b["mesh_restores"] >= 1, b
+        assert b["mesh_width"] == 4, b  # settled back at full width
+        assert b["repromotions"] >= 1, b
+        assert b["breakers"]["mesh_dev1"] == "closed", b
+        anomalies = res.spans["anomalies"]
+        assert anomalies.get("mesh_shrink", 0) >= 1, anomalies
+        assert anomalies.get("mesh_restore", 0) >= 1, anomalies
+
+    @pytest.mark.slow
+    def test_chip_death_deterministic(self, tmp_path, monkeypatch):
+        """Same seed => byte-identical traces AND anomaly dumps with the
+        elastic mesh in the verify path: breaker backoff rides the
+        virtual clock, flap/death counters are per-ordinal and seeded,
+        so the whole degradation story is a pure function of the seed.
+        (Slow lane: doubles a whole scenario run — PR-1/PR-3 precedent.)"""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")
+        a = run_scenario("chip-death", 7, root=tmp_path / "a")
+        b = run_scenario("chip-death", 7, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert a.heights == b.heights
+        assert a.backend == b.backend
+        assert a.spans["dumps"], a.spans
+        assert a.spans["dumps"] == b.spans["dumps"]
+
+    @pytest.mark.slow
+    def test_mesh_brownout_deterministic(self, tmp_path):
+        a = run_scenario("mesh-brownout", 11, root=tmp_path / "a")
+        b = run_scenario("mesh-brownout", 11, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert a.backend == b.backend
+
+
+# ----------------------------------------------------------------------
+# byzantine voting (ISSUE 13 satellite; ROADMAP item 5 follow-up)
+# ----------------------------------------------------------------------
+
+
+class TestByzantineVoter:
+    def test_equivocation_becomes_committed_evidence(self, tmp_path):
+        """A LIVE validator double-signs prevotes/precommits through the
+        production gossip path: honest nodes must detect the conflict in
+        their vote sets, convert it to DuplicateVoteEvidence at finalize
+        (the evidence pool's consensus buffer — no crafted evidence
+        anywhere), COMMIT it, and hold agreement + validator-set
+        invariants."""
+        res = run_scenario(
+            "byzantine-voter", 3, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        evd = res.evidence
+        assert evd["added"] > 0, evd  # real equivocations pooled
+        assert evd["committed"] > 0, evd  # and committed in blocks
+        assert evd["rejected"] == 0, evd  # nothing forged in this path
+        assert any("turns byzantine" in l for l in res.trace)
+        assert any("honest again" in l for l in res.trace)
+
+    def test_committed_evidence_names_the_byzantine_validator(
+        self, tmp_path
+    ):
+        """The committed duplicate-vote evidence must attribute to the
+        equivocating validator's address, with two votes at the same
+        (height, round, type) and different block ids — the production
+        evidence shape, end to end."""
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+        res = run_scenario(
+            "byzantine-voter", 5, root=tmp_path, raise_on_violation=True,
+            keep_cluster=True,
+        )
+        assert res.reached
+        cluster = res.cluster
+        byz_addr = cluster.privs[res.n_vals - 1].pub_key().address()
+        found = []
+        node = cluster.live_nodes()[0]
+        for h in range(1, node.block_store.height() + 1):
+            blk = node.block_store.load_block(h)
+            if blk is None:
+                continue
+            for ev in blk.evidence:
+                if isinstance(ev, DuplicateVoteEvidence):
+                    found.append(ev)
+        assert found, "no duplicate-vote evidence committed"
+        for ev in found:
+            assert ev.vote_a.validator_address == byz_addr
+            assert ev.vote_b.validator_address == byz_addr
+            assert ev.vote_a.height == ev.vote_b.height
+            assert ev.vote_a.round_ == ev.vote_b.round_
+            assert ev.vote_a.type_ == ev.vote_b.type_
+            assert ev.vote_a.block_id.hash != ev.vote_b.block_id.hash
+        cluster.stop()
+
+    @pytest.mark.slow
+    def test_byzantine_voter_deterministic(self, tmp_path):
+        a = run_scenario("byzantine-voter", 17, root=tmp_path / "a")
+        b = run_scenario("byzantine-voter", 17, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert a.heights == b.heights
+        assert a.evidence == b.evidence
