@@ -1193,6 +1193,149 @@ def run_meshfault(emit, n=256, reps=3, width=4) -> dict:
     return rec
 
 
+def run_diskfault(emit, n=128, seed=11) -> dict:
+    """Disk-fault supervisor stage (docs/storage-robustness.md).  Two
+    legs, both deterministic and platform-independent:
+
+      * **degrade leg** — verify verdicts with and without injected
+        storage faults on every DEGRADABLE surface (exec_cache ENOSPC,
+        blackbox EIO, status ENOSPC) must be BITWISE EQUAL: a disk fault
+        on a degradable surface may cost an optimization or a forensic
+        record, never a verdict.  The seam's counted-drop discipline is
+        asserted (drops > 0, zero fatals, the blackbox writer survives).
+
+      * **fail-stop leg** — the ``disk-full`` sim scenario, run TWICE
+        with the same seed: the victim node must fail-stop (height -1,
+        zero consensus participation after the halt), the survivors must
+        reach the target with agreement green, and the two runs' traces
+        must be byte-identical — the injector consumes the same rule
+        windows on the same IO sequence every time.
+
+    Emitted as stage="diskfault" and written to BENCH_DISKFAULT.json for
+    the bench_trend gate (dispatch-free: its hard numbers are counters)."""
+    import errno as _errno
+    import tempfile as _tempfile
+
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.libs import blackbox as bb
+    from cometbft_tpu.libs import diskguard as dg
+    from cometbft_tpu.libs import storage_stats
+    from cometbft_tpu.ops import verify as ov
+    from cometbft_tpu.sim.scenarios import run_scenario
+
+    pubs, msgs, sigs = _make_batch(n)
+    # two invalid lanes so equality is meaningful on a mixed batch
+    sigs = list(sigs)
+    sigs[1] = sigs[1][:-1] + bytes([sigs[1][-1] ^ 1])
+    sigs[n - 2] = bytes(64)
+    expected = np.array(
+        [ref.verify_zip215(p, m, s) for p, m, s in zip(pubs, msgs, sigs)],
+        dtype=bool,
+    )
+
+    # -- degrade leg ---------------------------------------------------------
+    storage_stats.reset()
+    bits_clean = np.asarray(ov.verify_batch(pubs, msgs, sigs), dtype=bool)
+    prev_plan = dg.set_fault_plan(dg.FaultPlan())
+    dg.set_sleeper(lambda _s: None)
+    tmpd = _tempfile.mkdtemp(prefix="bench-diskfault-")
+    try:
+        plan = dg.get_fault_plan()
+        plan.add(surface="exec_cache", err=_errno.ENOSPC)
+        plan.add(surface="status", err=_errno.ENOSPC)
+        plan.add(surface="blackbox", err=_errno.EIO)
+        # verdicts under active storage faults
+        bits_fault = np.asarray(
+            ov.verify_batch(pubs, msgs, sigs), dtype=bool
+        )
+        # the degradable seams really degrade: exec-cache publish fails
+        # as a counted drop surfaced to the caller...
+        try:
+            dg.atomic_write(
+                "exec_cache", os.path.join(tmpd, "entry.jexec"), b"payload"
+            )
+            exec_degraded = False
+        except OSError:
+            exec_degraded = True
+        # ...and the blackbox writer thread survives EIO with counted
+        # drops (forensics must never become a second failure)
+        j = bb.BlackboxJournal(
+            os.path.join(tmpd, "bbox"), threaded=True, queue_max=64
+        )
+        for i in range(16):
+            j.on_anomaly("bench_fault", {"i": i}, float(i))
+        j.close(clean=True)
+        bb_stats = j.stats()
+        snap = storage_stats.snapshot()["totals"]
+    finally:
+        dg.set_fault_plan(prev_plan)
+        dg.set_sleeper(None)
+        import shutil as _shutil
+
+        _shutil.rmtree(tmpd, ignore_errors=True)
+
+    # -- fail-stop leg -------------------------------------------------------
+    res_a = run_scenario("disk-full", seed)
+    res_b = run_scenario("disk-full", seed)
+    victim_halted = (
+        res_a.fail_stopped
+        and all(res_a.heights[v] == -1 for v in res_a.fail_stopped)
+    )
+    sim_storage = (res_a.storage or {}).get("totals", {})
+
+    rec = {
+        "metric": "disk_fault_supervisor",
+        "stage": "diskfault",
+        "batch": n,
+        "seed": seed,
+        "verdicts_equal": bool((bits_clean == bits_fault).all()),
+        "verdicts_match_oracle": bool((bits_clean == expected).all()),
+        "degrade_drops": snap["drops"],
+        "degrade_retries": snap["retries"],
+        "degrade_fatals": snap["fatals"],
+        "blackbox_dropped": bb_stats["dropped"],
+        "sim_reached": bool(res_a.reached),
+        "sim_violations": len(res_a.violations),
+        "sim_fail_stopped": list(res_a.fail_stopped),
+        "sim_fatals": sim_storage.get("fatals", 0),
+        "sim_trace_identical": res_a.trace == res_b.trace,
+        "survivor_height": max(res_a.heights),
+    }
+    emit(rec)
+    # hard invariants — a disk fault must never change a verdict, and a
+    # fail-stopped node must never participate after the halt
+    assert rec["verdicts_equal"], "verdicts diverged under disk faults"
+    assert rec["verdicts_match_oracle"], "verdicts diverged from oracle"
+    assert exec_degraded, "exec_cache fault did not surface as OSError"
+    assert snap["drops"] > 0, "no counted drops under injected faults"
+    assert snap["fatals"] == 0, (
+        "a degradable surface fault must never fail-stop"
+    )
+    assert bb_stats["dropped"] > 0 and bb_stats["closed"], (
+        "blackbox writer did not degrade to counted drops"
+    )
+    assert rec["sim_reached"] and rec["sim_violations"] == 0, (
+        res_a.violations or "survivors did not reach target"
+    )
+    assert victim_halted, (
+        f"fail-stopped node still participating: {res_a.heights}"
+    )
+    assert rec["sim_fatals"] >= 1, "disk-full run recorded no fatal"
+    assert rec["sim_trace_identical"], (
+        "disk-full traces diverged between same-seed runs"
+    )
+    out = os.path.join(REPO, "BENCH_DISKFAULT.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return rec
+
+
 def _loopback_cache_hit_rate() -> float:
     """Gossip-verify one round of precommits into a VoteSet, then re-verify
     the commit assembled from them (the apply-time LastCommit check) — the
@@ -2040,6 +2183,17 @@ def main() -> None:
         "BENCH_MESHFAULT_BATCH / _WIDTH size the run",
     )
     ap.add_argument(
+        "--diskfault",
+        action="store_true",
+        help="run only the disk-fault supervisor stage: verify verdicts "
+        "with and without injected storage faults on the degradable "
+        "surfaces must be bitwise-equal (counted drops, zero fatals), "
+        "and the disk-full sim scenario must fail-stop its victim with "
+        "zero consensus participation, byte-deterministically per seed; "
+        "writes BENCH_DISKFAULT.json for the bench_trend gate; "
+        "BENCH_DISKFAULT_BATCH / _SEED size the run",
+    )
+    ap.add_argument(
         "--warmboot",
         action="store_true",
         help="run only the warm-boot pipeline stage: two cold processes "
@@ -2129,6 +2283,12 @@ def main() -> None:
             _emit,
             n=int(os.environ.get("BENCH_MESHFAULT_BATCH", "256")),
             width=int(os.environ.get("BENCH_MESHFAULT_WIDTH", "4")),
+        )
+    elif args.diskfault:
+        run_diskfault(
+            _emit,
+            n=int(os.environ.get("BENCH_DISKFAULT_BATCH", "128")),
+            seed=int(os.environ.get("BENCH_DISKFAULT_SEED", "11")),
         )
     elif args.warmboot:
         run_warmboot(_emit)

@@ -618,7 +618,9 @@ def cmd_light(args) -> int:
         print(f"WARNING: trusting the primary's latest header blindly "
               f"(height {lb.height}); pass --trust-height/--trust-hash")
     os.makedirs(os.path.join(args.home, "light"), exist_ok=True)
-    store = LightStore(SqliteKV(os.path.join(args.home, "light", "trust.db")))
+    store = LightStore(
+        SqliteKV(os.path.join(args.home, "light", "trust.db"), surface="light")
+    )
     client = LightClient(args.chain_id, opts, primary, witnesses, store)
     proxy = LightProxy(client, args.primary, laddr=args.laddr)
     proxy.start()
@@ -653,7 +655,7 @@ def cmd_compact_db(args) -> int:
         print(f"no database at {path}")
         return 1
     before = os.path.getsize(path)
-    kv = SqliteKV(path)
+    kv = SqliteKV(path, surface="state")
     kv.compact()
     kv.close()
     after = os.path.getsize(path)
@@ -773,7 +775,8 @@ def cmd_reindex_event(args) -> int:
     if not os.path.exists(db_path):
         print(f"no database at {db_path}")
         return 1
-    db = SqliteKV(db_path)
+    db = SqliteKV(db_path, surface="state")
+    index_db = None
     try:
         block_store = BlockStore(db)
         state_store = StateStore(db)
@@ -794,7 +797,15 @@ def cmd_reindex_event(args) -> int:
         if cfg.tx_index.indexer == "kv":
             from cometbft_tpu.indexer import KVBlockIndexer, KVTxIndexer
 
-            tx_indexer, block_indexer = KVTxIndexer(db), KVBlockIndexer(db)
+            # the live node reads tx_index.db (degradable surface) —
+            # rebuilt rows written into chain.db would stay invisible
+            # until a later boot's legacy drain
+            index_db = SqliteKV(
+                os.path.join(cfg.base.home, cfg.base.db_dir, "tx_index.db"),
+                surface="indexer",
+            )
+            tx_indexer = KVTxIndexer(index_db)
+            block_indexer = KVBlockIndexer(index_db)
         elif cfg.tx_index.indexer == "psql":
             from cometbft_tpu.indexer.psql import (
                 PsqlBlockIndexerAdapter,
@@ -830,6 +841,8 @@ def cmd_reindex_event(args) -> int:
         print(f"reindexed {n_blocks} blocks, {n_txs} txs in [{start}, {end}]")
         return 0
     finally:
+        if index_db is not None:
+            index_db.close()
         db.close()
 
 

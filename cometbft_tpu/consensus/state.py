@@ -47,6 +47,7 @@ from cometbft_tpu.consensus.types import (
 from cometbft_tpu.consensus.wal import WAL
 from cometbft_tpu.libs import log as liblog
 from cometbft_tpu.libs import tracing
+from cometbft_tpu.libs.diskguard import StorageFatal
 from cometbft_tpu.libs.service import BaseService
 from cometbft_tpu.state.execution import BlockExecutor
 from cometbft_tpu.state.state import State
@@ -119,6 +120,12 @@ class ConsensusState(BaseService):
         # gossip layer can fan it out to peers (reference gossips from
         # RoundState; push is equivalent for in-process wiring)
         self.broadcast_hook: Optional[Callable[[object], None]] = None
+        # disk fail-stop (docs/storage-robustness.md): a StorageFatal from
+        # the WAL / privval / state store halts this node BEFORE it can
+        # vote or commit on unpersisted state; the hook lets the host
+        # (node assembly, sim cluster) react to the halt
+        self.on_storage_fatal: Optional[Callable[[StorageFatal], None]] = None
+        self.storage_fatal_err: Optional[StorageFatal] = None
         # test hook: observe each (height, round, step) transition
         self.step_hook: Optional[Callable[[RoundState], None]] = None
         # reactor listeners (reference: reactor subscribes to internal
@@ -227,6 +234,8 @@ class ConsensusState(BaseService):
             if kind == "quit":
                 return
             self._process_one(kind, payload)
+            if self.storage_fatal_err is not None:
+                return
 
     def process_pending(self) -> int:
         """Drain queued inputs synchronously; returns how many were handled.
@@ -244,6 +253,10 @@ class ConsensusState(BaseService):
                 return n
             self._process_one(kind, payload)
             n += 1
+            if self.storage_fatal_err is not None:
+                # fail-stopped mid-drain: queued inputs must not be
+                # processed on top of unpersisted state
+                return n
 
     def _process_one(self, kind: str, payload: object) -> None:
         try:
@@ -274,6 +287,10 @@ class ConsensusState(BaseService):
                 self._handle_timeout(ti)
             elif kind == "txs":
                 self._handle_txs_available()
+        except StorageFatal as e:
+            # fail-stop: the durable state backing consensus safety can no
+            # longer advance — halt before voting/committing on it
+            self._storage_fatal(e)
         except Exception as e:  # noqa: BLE001 — consensus must not die silently
             self.logger.error(
                 "consensus failure", err=repr(e), height=self.rs.height
@@ -281,6 +298,38 @@ class ConsensusState(BaseService):
             import traceback
 
             traceback.print_exc()
+
+    def _storage_fatal(self, e: StorageFatal) -> None:
+        """Halt this node on a fail-stop storage failure.  The WAL write,
+        privval sign-state persist or store commit that raised ``e``
+        happened BEFORE any vote was released or state transition applied
+        (write-ahead ordering), so halting here can never equivocate —
+        the node simply goes silent, like a crash (the one failure mode
+        BFT already budgets f for)."""
+        if self.storage_fatal_err is not None:
+            return
+        self.storage_fatal_err = e
+        self.logger.error(
+            "STORAGE FATAL — halting node",
+            surface=e.surface,
+            op=e.op,
+            err=repr(e.err),
+            height=self.rs.height,
+        )
+        if self._thread is threading.current_thread():
+            # on_stop would join the receive thread we are running on
+            self._thread = None
+        try:
+            self.stop()
+        except Exception as stop_err:  # noqa: BLE001 — already halting
+            self.logger.error("fail-stop cleanup failed", err=repr(stop_err))
+        if self.on_storage_fatal is not None:
+            try:
+                self.on_storage_fatal(e)
+            except Exception as hook_err:  # noqa: BLE001
+                self.logger.error(
+                    "storage-fatal hook failed", err=repr(hook_err)
+                )
 
     def _tock(self, ti: TimeoutInfo) -> None:
         self._queue.put(("timeout", ti))
@@ -653,6 +702,8 @@ class ConsensusState(BaseService):
         )
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except StorageFatal:
+            raise  # fail-stop: _process_one halts the node
         except Exception as e:  # noqa: BLE001
             self.logger.error("failed to sign proposal", err=repr(e))
             return
@@ -1381,6 +1432,8 @@ class ConsensusState(BaseService):
             self.priv_validator.sign_vote(
                 self.state.chain_id, vote, sign_extension=ext_enabled and type_ == PRECOMMIT_TYPE
             )
+        except StorageFatal:
+            raise  # fail-stop: the vote must NOT be released or broadcast
         except Exception as e:  # noqa: BLE001 — double-sign protection etc.
             self.logger.error("failed to sign vote", err=repr(e))
             return None

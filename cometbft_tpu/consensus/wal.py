@@ -18,6 +18,8 @@ import zlib
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from cometbft_tpu.libs import diskguard as _dg
+
 MAX_MSG_SIZE = 1 << 20  # 1 MB per WAL record
 _REC_DATA = 1
 _REC_END_HEIGHT = 2
@@ -43,6 +45,35 @@ def _frame(kind: int, payload: bytes) -> bytes:
     return struct.pack(">II", crc, len(body)) + body
 
 
+def read_frame(f) -> "tuple[Optional[int], Optional[bytes], Optional[str]]":
+    """Read ONE CRC32+length frame from a binary stream — the single
+    decode under every walker (strict replay, tolerant tail scans, the
+    boot-time scrub, the sim's mid-frame cutter), so a frame-format
+    change has exactly one parser to touch.  Returns ``(kind, payload,
+    None)`` for a valid frame, else ``(None, None, reason)``: ``"eof"``
+    at a clean frame boundary, or the corruption reason a strict reader
+    raises (torn header/body, bogus length, CRC mismatch)."""
+    hdr = f.read(8)
+    if not hdr:
+        return None, None, "eof"
+    if len(hdr) < 8:
+        return None, None, "truncated record header"
+    crc, length = struct.unpack(">II", hdr)
+    if length == 0:
+        # 8 zero bytes pass the CRC check (crc32(b"")==0) but a real
+        # frame always carries a kind byte — this is the zero-filled
+        # tail ext4 leaves after a power cut, not a record
+        return None, None, "zero-length record"
+    if length > MAX_MSG_SIZE + 1:
+        return None, None, "record too large"
+    body = f.read(length)
+    if len(body) < length:
+        return None, None, "truncated record body"
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        return None, None, "crc mismatch"
+    return body[0], body[1:], None
+
+
 class WALCorruptionError(Exception):
     pass
 
@@ -54,6 +85,13 @@ class WAL:
         self.path = path
         self.head_size_limit = head_size_limit
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # boot-time crash-consistency scrub (docs/storage-robustness.md):
+        # truncate a torn head-file tail back to the last CRC-valid frame
+        # BEFORE appending — new frames written after torn bytes would be
+        # swallowed by the torn header's bogus length on strict replay
+        self.last_repair: Optional[dict] = None
+        if _dg.enabled():
+            self.repair_tail()
         # write path: native C++ engine when available (same frame bytes;
         # cometbft_tpu/native csrc wal_*), else buffered Python file
         from cometbft_tpu import native as _native
@@ -70,20 +108,96 @@ class WAL:
             self._nlib = None
             self._f = open(self.path, "ab")
 
+    # -- crash-consistency scrub ------------------------------------------
+
+    def repair_tail(self) -> Optional[dict]:
+        """Truncate a torn/corrupt HEAD-file tail to the last CRC-valid
+        frame boundary (the storage analog of the black box's torn-tail
+        decode) and journal the repair.  Returns the repair info (also
+        kept on ``last_repair``) or None when the tail was clean.  Only
+        the head file is touched: rolled files were fsync'd at rotation
+        and mid-stream damage there is evidence, not a tail."""
+        if not os.path.exists(self.path):
+            return None
+        size = os.path.getsize(self.path)
+        good = 0
+        with open(self.path, "rb") as f:
+            while True:
+                _kind, payload, reason = read_frame(f)
+                if reason is not None:
+                    break
+                good += 9 + len(payload)  # 8-byte header + kind + payload
+            if good >= size:
+                return None
+            f.seek(good)
+            tail = f.read()
+        # A complete CRC-valid frame anywhere PAST the first bad byte
+        # means durable (possibly fsync'd) records follow the corruption
+        # — that is mid-stream damage, not a torn tail.  Truncating here
+        # would silently discard consensus input the node already relied
+        # on, so keep the pre-repair fail-fast for this case: halt and
+        # leave the evidence on disk for the operator.
+        for i in range(1, len(tail) - 8):
+            crc, length = struct.unpack_from(">II", tail, i)
+            if length == 0 or length > MAX_MSG_SIZE + 1:
+                continue
+            if i + 8 + length > len(tail):
+                continue
+            if zlib.crc32(tail[i + 8 : i + 8 + length]) & 0xFFFFFFFF == crc:
+                from cometbft_tpu.libs import storage_stats, tracing
+
+                storage_stats.record_fatal("wal")
+                tracing.record_anomaly(
+                    "disk_fatal", surface="wal", op="repair",
+                    errno=-1, error="WALCorruptionError",
+                )
+                raise WALCorruptionError(
+                    "mid-stream WAL corruption at byte %d of %s: a valid "
+                    "frame follows the damage at offset %d — refusing to "
+                    "truncate durable records" % (good, self.path, good + i)
+                )
+        dropped = size - good
+        _dg.guard(
+            "wal", "repair", lambda: os.truncate(self.path, good),
+            path=self.path,
+        )
+        self.last_repair = {
+            "path": self.path,
+            "good_bytes": good,
+            "dropped_bytes": dropped,
+        }
+        from cometbft_tpu.libs import storage_stats, tracing
+
+        storage_stats.record_repair("wal", dropped)
+        tracing.note_event(
+            "wal_repair",
+            path=self.path,
+            good_bytes=good,
+            dropped_bytes=dropped,
+        )
+        return self.last_repair
+
     # -- writing ----------------------------------------------------------
 
     def _append(self, kind: int, payload: bytes, sync: bool) -> None:
         if self._nh is not None:
-            rc = self._nlib.wal_append(
-                self._nh, kind, payload, len(payload), 1 if sync else 0
-            )
-            if rc != 0:
-                raise OSError("native WAL append failed")
+
+            def native_append() -> None:
+                rc = self._nlib.wal_append(
+                    self._nh, kind, payload, len(payload), 1 if sync else 0
+                )
+                if rc != 0:
+                    raise OSError("native WAL append failed")
+
+            _dg.guard("wal", "append", native_append, path=self.path)
         else:
-            self._f.write(_frame(kind, payload))
+            _dg.file_write(
+                "wal", self._f, _frame(kind, payload),
+                op="append", path=self.path,
+            )
             if sync:
-                self._f.flush()
-                os.fsync(self._f.fileno())
+                _dg.flush("wal", self._f, path=self.path)
+                _dg.fsync("wal", self._f, path=self.path)
 
     def _head_size(self) -> int:
         if self._nh is not None:
@@ -111,10 +225,13 @@ class WAL:
 
     def flush_and_sync(self) -> None:
         if self._nh is not None:
-            self._nlib.wal_sync(self._nh)
+            _dg.guard(
+                "wal", "fsync", lambda: self._nlib.wal_sync(self._nh),
+                path=self.path,
+            )
         elif self._f is not None:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            _dg.flush("wal", self._f, path=self.path)
+            _dg.fsync("wal", self._f, path=self.path)
 
     def _maybe_rotate(self) -> None:
         if self._head_size() < self.head_size_limit:
@@ -180,28 +297,14 @@ class WAL:
         for fp in self._files():
             with open(fp, "rb") as f:
                 while True:
-                    hdr = f.read(8)
-                    if not hdr:
+                    kind, payload, reason = read_frame(f)
+                    if reason == "eof":
                         break
-                    if len(hdr) < 8:
+                    if reason is not None:
                         if strict:
-                            raise WALCorruptionError("truncated record header")
+                            raise WALCorruptionError(reason)
                         return
-                    crc, length = struct.unpack(">II", hdr)
-                    if length > MAX_MSG_SIZE + 1:
-                        if strict:
-                            raise WALCorruptionError("record too large")
-                        return
-                    body = f.read(length)
-                    if len(body) < length:
-                        if strict:
-                            raise WALCorruptionError("truncated record body")
-                        return
-                    if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                        if strict:
-                            raise WALCorruptionError("crc mismatch")
-                        return
-                    yield WALRecord(kind=body[0], payload=body[1:])
+                    yield WALRecord(kind=kind, payload=payload)
 
     def scan_end_heights(self, start: int = 0) -> tuple[set, int]:
         """Incrementally collect #ENDHEIGHT markers from the HEAD file,
@@ -223,20 +326,12 @@ class WAL:
             f.seek(start)
             offset = start
             while True:
-                hdr = f.read(8)
-                if len(hdr) < 8:
+                kind, payload, reason = read_frame(f)
+                if reason is not None:
                     break
-                crc, length = struct.unpack(">II", hdr)
-                if length > MAX_MSG_SIZE + 1:
-                    break
-                body = f.read(length)
-                if len(body) < length:
-                    break
-                if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                    break
-                if body[0] == _REC_END_HEIGHT:
-                    heights.add(int.from_bytes(body[1:], "big"))
-                offset += 8 + length
+                if kind == _REC_END_HEIGHT:
+                    heights.add(int.from_bytes(payload, "big"))
+                offset += 9 + len(payload)
         return heights, offset
 
     def search_for_end_height(self, height: int) -> bool:

@@ -15,6 +15,7 @@ from __future__ import annotations
 import base64
 import struct
 from dataclasses import dataclass, field
+from itertools import islice
 from typing import Optional
 
 from cometbft_tpu.abci import types as at
@@ -28,6 +29,44 @@ _BLOCK_EVENT = b"bhe/"
 TX_HASH_TAG = "tx.hash"
 TX_HEIGHT_TAG = "tx.height"
 BLOCK_HEIGHT_TAG = "block.height"
+
+
+def migrate_legacy_index(chain_db, index_db, chunk: int = 4096) -> int:
+    """Move KV index entries out of the shared chain db into the
+    dedicated index db (the surfaces split when the indexer became
+    degradable while chain.db stayed fail-stop: docs/storage-robustness.md).
+    Pre-split nodes left ``txh/``/``txe/``/``bhe/`` keys in chain.db;
+    without this, tx_search/block_search silently lose every pre-split
+    height.  Idempotent and crash-resumable: each boot moves whatever
+    legacy keys remain (three cheap range probes once drained).  Returns
+    the number of rows moved."""
+    moved = 0
+    for prefix in (_TX_PRIMARY, _TX_EVENT, _BLOCK_EVENT):
+        # upper bound: prefix with its last byte incremented — key bodies
+        # may contain 0xff (raw tx hashes), so ``prefix + b"\xff"`` would
+        # clip the tail of the range
+        end = prefix[:-1] + bytes([prefix[-1] + 1])
+        # paged stream (snapshot=False) keeps boot memory bounded however
+        # large the legacy index; deleting a consumed chunk never
+        # disturbs the scan — it only removes keys the cursor is past
+        it = chain_db.iterate(prefix, end, snapshot=False)
+        while True:
+            part = list(islice(it, chunk))
+            if not part:
+                break
+            # copy INTO the index db before deleting from chain.db so a
+            # crash between the two leaves duplicates (harmless: same
+            # bytes), never lost index entries.  The delete runs under
+            # the DEGRADABLE indexer policy even though the file is the
+            # fail-stop chain db: the rows are index data, and a failed
+            # cleanup must count a drop and resume next boot — not latch
+            # the storage-fatal flag on a node that then keeps running
+            index_db.write_batch(part, [])
+            chain_db.write_batch(
+                [], [k for k, _ in part], surface="indexer"
+            )
+            moved += len(part)
+    return moved
 
 
 @dataclass

@@ -146,9 +146,13 @@ class BlackboxJournal:
         # _qlock guards the queue + drop counter (the only lock the hot
         # path ever takes in threaded mode); _iolock guards the file — the
         # writer thread does its IO under _iolock alone, so a caller can
-        # never block behind a disk write
+        # never block behind a disk write.  REENTRANT: a failed journal
+        # write's own ``disk_fault`` anomaly (diskguard) sinks back into
+        # this journal on the same thread — with a plain Lock that is a
+        # self-deadlock; the diskguard anomaly latch bounds the nesting
+        # at one level
         self._qlock = threading.Lock()
-        self._iolock = threading.Lock()
+        self._iolock = threading.RLock()
         self._wake = threading.Condition(self._qlock)
         self._f: Optional[io.BufferedWriter] = None
         self._unflushed = 0
@@ -291,24 +295,41 @@ class BlackboxJournal:
         self._maybe_health()
 
     def _write_io(self, frame: bytes, sync: int) -> None:
-        """One frame to the head segment; caller holds ``_iolock``."""
+        """One frame to the head segment; caller holds ``_iolock``.
+
+        Write / flush / fsync route through the diskguard seam (surface
+        ``blackbox``, DEGRADABLE): transient EIO gets a bounded
+        exponential-backoff retry, an exhausted fault degrades to the
+        counted drop below plus a ``disk_fault`` anomaly — the writer
+        thread survives and later records keep landing."""
+        from cometbft_tpu.libs import diskguard as _dg
+
         if self._f is None:
             self.dropped += 1
             return
+        written = False
         try:
             self._rotate_locked(len(frame))
-            self._f.write(frame)
+            _dg.file_write(
+                "blackbox", self._f, frame, op="write", path=self.head_path
+            )
             self.records += 1
             self.bytes_written += len(frame)
+            written = True
             self._unflushed += 1
             if sync >= self.SYNC_FLUSH or self._unflushed >= self.flush_every:
-                self._f.flush()
+                _dg.flush("blackbox", self._f, path=self.head_path)
                 self._unflushed = 0
             if sync >= self.SYNC_FSYNC:
-                os.fsync(self._f.fileno())
+                _dg.fsync("blackbox", self._f, path=self.head_path)
         except OSError as e:  # forensics must never become a second failure
             logger.warning("blackbox write failed: %r", e)
-            self.dropped += 1
+            # only a failed WRITE drops the frame; a failed flush/fsync
+            # leaves the bytes buffered (a later flush may still land
+            # them) and is already counted by the guard's surface stats —
+            # records + dropped must never exceed frames submitted
+            if not written:
+                self.dropped += 1
 
     def _writer_loop(self) -> None:
         while True:

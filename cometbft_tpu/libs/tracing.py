@@ -1009,6 +1009,11 @@ def trace_document(
 
         return blackbox.journal_stats() or {"enabled": blackbox.enabled()}
 
+    def _storage():
+        from cometbft_tpu.libs import storage_stats
+
+        return storage_stats.snapshot()
+
     section("backend", _backend)
     section("sigcache", _sigcache)
     section("dispatch", _dispatch)
@@ -1017,4 +1022,5 @@ def trace_document(
     section("ingest", _ingest)
     section("device", _device)
     section("blackbox", _blackbox)
+    section("storage", _storage)
     return doc

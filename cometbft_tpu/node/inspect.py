@@ -17,7 +17,7 @@ from cometbft_tpu.libs import log as liblog
 from cometbft_tpu.state.state import state_from_genesis
 from cometbft_tpu.state.store import StateStore
 from cometbft_tpu.store.block_store import BlockStore
-from cometbft_tpu.store.kv import open_kv
+from cometbft_tpu.store.kv import UnionKV, open_kv
 from cometbft_tpu.types.genesis import GenesisDoc
 
 
@@ -54,7 +54,9 @@ class InspectNode:
         home = config.base.home
         data_dir = os.path.join(home, config.base.db_dir)
         self.db = open_kv(
-            config.base.db_backend, os.path.join(data_dir, "chain.db")
+            config.base.db_backend,
+            os.path.join(data_dir, "chain.db"),
+            surface="state",
         )
         self.block_store = BlockStore(self.db)
         self.state_store = StateStore(self.db)
@@ -65,8 +67,23 @@ class InspectNode:
             state = state_from_genesis(self.genesis_doc)
         self.state = state
         self.consensus = _StubConsensus(state)
-        self.tx_indexer = KVTxIndexer(self.db)
-        self.block_indexer = KVBlockIndexer(self.db)
+        # the live node keeps its index in a dedicated tx_index.db
+        # (degradable surface); pre-split data dirs still hold it inside
+        # chain.db.  Inspect never migrates: it reads through a union of
+        # the two so even a partially drained legacy index serves every
+        # height
+        index_path = os.path.join(data_dir, "tx_index.db")
+        if os.path.exists(index_path):
+            self.index_db = open_kv(
+                config.base.db_backend, index_path, surface="indexer"
+            )
+            index_view = UnionKV(
+                self.index_db, self.db, fallback_surface="indexer"
+            )
+        else:
+            self.index_db = index_view = self.db
+        self.tx_indexer = KVTxIndexer(index_view)
+        self.block_indexer = KVBlockIndexer(index_view)
         self.node_key = _StubNodeKey()
         self.switch = None
         self.evidence_pool = None
@@ -97,4 +114,6 @@ class InspectNode:
     def close(self) -> None:
         if self.rpc_server is not None:
             self.rpc_server.stop()
+        if self.index_db is not self.db:
+            self.index_db.close()
         self.db.close()

@@ -75,7 +75,9 @@ class Node(BaseService):
         data_dir = os.path.join(home, config.base.db_dir)
         os.makedirs(data_dir, exist_ok=True)
         self.db = open_kv(
-            config.base.db_backend, os.path.join(data_dir, "chain.db")
+            config.base.db_backend,
+            os.path.join(data_dir, "chain.db"),
+            surface="state",
         )
         self.block_store = BlockStore(self.db)
         self.state_store = StateStore(self.db)
@@ -142,11 +144,56 @@ class Node(BaseService):
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
+        self.index_db = None
         if config.tx_index.indexer == "kv":
             from cometbft_tpu.indexer import KVBlockIndexer, KVTxIndexer
 
-            self.tx_indexer = KVTxIndexer(self.db)
-            self.block_indexer = KVBlockIndexer(self.db)
+            # own DB under the DEGRADABLE ``indexer`` surface (reference
+            # keeps a separate tx_index db too, node.go DBContext): an
+            # index write failure is a counted drop + anomaly, never a
+            # halted node — unlike chain.db's fail-stop ``state`` surface
+            self.index_db = open_kv(
+                config.base.db_backend,
+                os.path.join(data_dir, "tx_index.db"),
+                surface="indexer",
+            )
+            # pre-split data dirs hold their index inside chain.db —
+            # drain it across so tx_search keeps seeing old heights.
+            # The indexer surface is DEGRADABLE: a failed drain must not
+            # halt boot (it resumes next boot; queries are merely stale)
+            from cometbft_tpu.indexer.kv import migrate_legacy_index
+
+            drained = False
+            try:
+                moved = migrate_legacy_index(self.db, self.index_db)
+                drained = True  # the drain loops ran to empty ranges
+            except Exception as e:  # noqa: BLE001 — degrade, never halt
+                moved = 0
+                self.logger.error(
+                    "legacy tx index migration failed; "
+                    "will resume next boot", err=repr(e)
+                )
+            if moved:
+                self.logger.info(
+                    "migrated legacy tx index out of chain.db", rows=moved
+                )
+            if drained:
+                # chain.db provably holds zero legacy index rows — bind
+                # the indexers straight to tx_index.db; a permanent
+                # union view would charge every query a chain.db lookup
+                # for rows that can never exist there
+                index_view = self.index_db
+            else:
+                # interrupted drain: read through the union of the two
+                # dbs (writes go to tx_index.db) so pre-split heights
+                # don't vanish from tx_search until a later boot drains
+                from cometbft_tpu.store.kv import UnionKV
+
+                index_view = UnionKV(
+                    self.index_db, self.db, fallback_surface="indexer"
+                )
+            self.tx_indexer = KVTxIndexer(index_view)
+            self.block_indexer = KVBlockIndexer(index_view)
         elif config.tx_index.indexer == "psql":
             from cometbft_tpu.indexer.psql import (
                 PsqlBlockIndexerAdapter,
@@ -682,6 +729,8 @@ class Node(BaseService):
             else:
                 self._blackbox.close(clean=True)
             self._blackbox = None
+        if self.index_db is not None:
+            self.index_db.close()
         self.db.close()
         self.logger.info("node stopped")
 

@@ -21,7 +21,11 @@ class RollbackError(Exception):
 
 def rollback_state(cfg, remove_block: bool = False) -> tuple[int, bytes]:
     data_dir = os.path.join(cfg.base.home, cfg.base.db_dir)
-    db = open_kv(cfg.base.db_backend, os.path.join(data_dir, "chain.db"))
+    db = open_kv(
+        cfg.base.db_backend,
+        os.path.join(data_dir, "chain.db"),
+        surface="state",
+    )
     try:
         state_store = StateStore(db)
         block_store = BlockStore(db)

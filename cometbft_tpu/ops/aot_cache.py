@@ -261,10 +261,13 @@ def store(tag: str, compiled) -> str:
     try:
         os.makedirs(d, exist_ok=True)
         path = _path(tag, platform, fingerprint)
-        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-        os.replace(tmp, path)
+        # diskguard seam (surface ``exec_cache``, degradable): injected or
+        # real IO faults retry transients, then degrade to the
+        # ``unwritable`` status below — the run only loses warm boots.
+        # No fsync, as before: a torn entry is detected and recompiled.
+        from cometbft_tpu.libs import diskguard as _dg
+
+        _dg.atomic_write("exec_cache", path, payload, do_fsync=False)
     except OSError as e:
         return f"unwritable:{type(e).__name__}"
     warm_stats.record_write(len(payload))

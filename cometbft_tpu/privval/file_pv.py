@@ -10,13 +10,14 @@ after restart — the core double-sign protection.
 from __future__ import annotations
 
 import base64
+import binascii
 import json
 import os
-import tempfile
 from dataclasses import dataclass, field
 from typing import Optional
 
 from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.libs import diskguard as _dg
 from cometbft_tpu.types.basic import PRECOMMIT_TYPE, PREVOTE_TYPE
 from cometbft_tpu.types.vote import Proposal, Vote
 
@@ -68,20 +69,25 @@ class DoubleSignError(Exception):
     pass
 
 
+class PrivValStateError(_dg.StorageFatal):
+    """The last-sign-state file exists but cannot be trusted (torn,
+    truncated, or garbage).  This is FAIL-STOP by construction: silently
+    falling back to a fresh last-sign state would let a restarted
+    validator re-sign a conflicting vote for an (H, R, step) it already
+    signed — a double-sign waiting to happen.  The operator must restore
+    or explicitly delete the state file."""
+
+    def __init__(self, path: str, err: "BaseException | str"):
+        super().__init__("privval", "load", err)
+        self.path = path
+
+
 def _atomic_write(path: str, data: bytes) -> None:
-    d = os.path.dirname(path) or "."
-    os.makedirs(d, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=d)
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    """Durable sign-state/key write through the diskguard seam (surface
+    ``privval``, fail-stop): the write, flush, fsync and rename each halt
+    the validator on failure — a signature must never be released on
+    unpersisted sign state."""
+    _dg.atomic_write("privval", path, data, do_fsync=True)
 
 
 @dataclass
@@ -132,15 +138,25 @@ class FilePV:
         priv = Ed25519PrivKey.from_seed(base64.b64decode(doc["priv_key"]["value"]))
         pv = FilePV(priv, key_path, state_path)
         if os.path.exists(state_path):
-            with open(state_path) as f:
-                st = json.load(f)
-            pv._state = _LastSignState(
-                height=int(st["height"]),
-                round_=st["round"],
-                step=st["step"],
-                signature=base64.b64decode(st.get("signature", "")),
-                sign_bytes=bytes.fromhex(st.get("signbytes", "")),
-            )
+            # fail-stop on a corrupt or torn state file: a typed error,
+            # never a silent fresh-state fallback (see PrivValStateError)
+            try:
+                with open(state_path) as f:
+                    st = json.load(f)
+                pv._state = _LastSignState(
+                    height=int(st["height"]),
+                    round_=int(st["round"]),
+                    step=int(st["step"]),
+                    signature=base64.b64decode(st.get("signature", "")),
+                    sign_bytes=bytes.fromhex(st.get("signbytes", "")),
+                )
+            except (
+                ValueError,
+                KeyError,
+                TypeError,
+                binascii.Error,
+            ) as e:
+                raise PrivValStateError(state_path, e) from e
         return pv
 
     @staticmethod

@@ -133,6 +133,11 @@ class SimCluster:
         # byte-compared trace) before reopening it.
         self.blackbox: dict = {}
         self.postmortems: list[dict] = []
+        # nodes halted by a fail-stop storage failure (StorageFatal from
+        # the WAL / privval / state surfaces): they leave ``members`` —
+        # 'the cluster made it' means no SURVIVOR left behind; a
+        # fail-stopped node is an operator page, not a laggard
+        self.fail_stopped: set[int] = set()
         self._bb_enabled = blackbox.enabled()
         self._bb_prev_sinks: Optional[dict] = None
         if self._bb_enabled:
@@ -180,6 +185,7 @@ class SimCluster:
         # is read at send time — the anchor may have been adopted since
         node.cs.trace_origin = i
         node.cs.broadcast_hook = lambda msg, i=i: self._broadcast(i, msg)
+        node.cs.on_storage_fatal = lambda e, i=i: self._on_storage_fatal(i, e)
         if self._bb_enabled:
             j = blackbox.BlackboxJournal(
                 str(self.root / f"node{i}" / "blackbox"),
@@ -193,7 +199,48 @@ class SimCluster:
             )
             j.on_event("boot", {"node": i})
             self.blackbox[i] = j
+        # a WAL tail repair at this boot (the crash-consistency scrub,
+        # docs/storage-robustness.md) lands in the trace AND the node's
+        # fresh journal — the torn-wal-restart scenario asserts both
+        repair = (
+            node.cs.wal.last_repair if node.cs.wal is not None else None
+        )
+        if repair is not None:
+            self._log(
+                "node%d wal_repair: truncated %d torn byte(s) to %d"
+                % (i, repair["dropped_bytes"], repair["good_bytes"])
+            )
+            j = self.blackbox.get(i)
+            if j is not None and not j.closed:
+                j.on_event("wal_repair", {"node": i, **repair})
         return node
+
+    def _on_storage_fatal(self, i: int, err) -> None:
+        """A node hit a fail-stop storage failure: it has already halted
+        its consensus state machine (before voting on unpersisted state —
+        ``ConsensusState._storage_fatal``); here the cluster retires it
+        like a crash whose operator never comes back.  It leaves
+        ``members`` so ``reached`` measures the SURVIVORS — the agreement
+        invariant still covers everything it committed before the halt."""
+        self._log(
+            "node%d STORAGE FATAL %s/%s: fail-stop halt (errno=%s)"
+            % (i, err.surface, err.op, err.io_errno)
+        )
+        node = self.nodes[i]
+        if node is None:
+            return
+        self.nodes[i] = None
+        self.members.discard(i)
+        self.fail_stopped.add(i)
+        node.app_conns.stop()
+        j = self.blackbox.get(i)
+        if j is not None and not j.closed:
+            # a fail-stop is a DELIBERATE halt: the process exits through
+            # its shutdown path, so the journal gets its clean-close
+            # sentinel (the disk_fatal anomaly is already journaled; on a
+            # truly full disk the sentinel itself degrades to a counted
+            # drop through the blackbox surface guard)
+            j.close(clean=True)
 
     # -- black-box routing -------------------------------------------------
     #

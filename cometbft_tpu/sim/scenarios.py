@@ -139,6 +139,11 @@ class ScenarioResult:
     # crashed node, captured before the run root is deleted
     blackbox: dict = field(default_factory=dict)
     postmortems: list = field(default_factory=list)
+    # disk-fault supervisor capture (libs/diskguard): per-surface
+    # write/fsync/retry/drop/fatal/repair counters — attached when the
+    # run saw injector or real-IO trouble — plus the fail-stopped nodes
+    storage: dict = field(default_factory=dict)
+    fail_stopped: list = field(default_factory=list)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -186,6 +191,25 @@ class ScenarioResult:
             row["rotations"] = self.rotations
         if self.blackbox:
             row["blackbox"] = dict(self.blackbox)
+        if self.storage:
+            t = self.storage.get("totals", {})
+            row["storage"] = {
+                k: t.get(k, 0)
+                for k in (
+                    "writes",
+                    "fsyncs",
+                    "retries",
+                    "drops",
+                    "fatals",
+                    "injected",
+                    "repairs",
+                    "repaired_bytes",
+                )
+            }
+            if self.fail_stopped:
+                row["storage"]["fail_stopped_nodes"] = list(
+                    self.fail_stopped
+                )
         if self.spans:
             row["spans"] = {
                 "recorded": self.spans.get("recorded", 0),
@@ -1356,6 +1380,129 @@ def _evidence_teardown(cluster: SimCluster) -> None:
     evstats.reset()
 
 
+# -- disk-fault scenarios (docs/storage-robustness.md) ------------------------
+
+
+def _disk_setup(cluster: SimCluster) -> None:
+    """Install a fresh ``diskguard.FaultPlan`` for the run and pin the
+    retry-backoff sleeper to a no-op: injection windows are COUNT-based
+    (rule ordinals over the deterministic per-seed IO sequence), so wall
+    sleeps would only slow the run without adding determinism."""
+    from cometbft_tpu.libs import diskguard as dg
+
+    cluster._disk_prev_plan = dg.set_fault_plan(dg.FaultPlan())
+    dg.set_sleeper(lambda _s: None)
+
+
+def _disk_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu.libs import diskguard as dg
+
+    dg.set_fault_plan(getattr(cluster, "_disk_prev_plan", None))
+    dg.set_sleeper(None)
+
+
+DISK_VICTIM = 1  # the node whose disk the disk-* scenarios break
+
+
+def _disk_full(s: Scenario) -> list[Action]:
+    """ENOSPC on one node's whole disk at t=5: its WAL (fail-stop) halts
+    it before its next vote — no equivocation, ever — while its blackbox
+    journal (degradable) degrades to counted drops.  The survivors keep
+    agreement and reach the target without it."""
+
+    def fill(c: SimCluster) -> None:
+        import errno as _errno
+
+        from cometbft_tpu.libs import diskguard as dg
+
+        plan = dg.get_fault_plan()
+        c._log(
+            "scenario: node%d disk full (ENOSPC, wal fail-stop + "
+            "blackbox degrade)" % DISK_VICTIM
+        )
+        node_tag = "node%d/" % DISK_VICTIM
+        plan.add(
+            surface="wal", path_substr=node_tag, err=_errno.ENOSPC
+        )
+        plan.add(
+            surface="blackbox", path_substr=node_tag, err=_errno.ENOSPC
+        )
+
+    return [Action(5.0, "disk full on node%d" % DISK_VICTIM, fill)]
+
+
+def _disk_brownout(s: Scenario) -> list[Action]:
+    """Transient EIO bursts against the degradable blackbox surface:
+    short bursts (shorter than the retry budget) recover via bounded
+    exponential backoff with ZERO drops; one long burst exhausts the
+    budget and degrades to counted drops.  Consensus never notices."""
+
+    def burst(c: SimCluster, n: int) -> None:
+        import errno as _errno
+
+        from cometbft_tpu.libs import diskguard as dg
+
+        dg.get_fault_plan().add(
+            surface="blackbox", err=_errno.EIO, count=n
+        )
+        c._log("scenario: blackbox EIO burst len=%d" % n)
+
+    return [
+        Action(4.0, "EIO burst (retries recover)", lambda c: burst(c, 2)),
+        Action(6.0, "EIO burst (retries recover)", lambda c: burst(c, 2)),
+        Action(8.0, "EIO burst (retries recover)", lambda c: burst(c, 2)),
+        Action(10.0, "EIO burst (exhausts retries)", lambda c: burst(c, 8)),
+    ]
+
+
+def _torn_wal_restart(s: Scenario) -> list[Action]:
+    """Kill a node mid-frame: crash it, then cut its WAL head mid-way
+    through the final frame — the torn tail a power cut leaves.  On
+    restart the boot-time scrub truncates to the last CRC-valid frame
+    (``wal_repair`` journaled, dropped bytes counted), the node replays
+    to the repaired tail and rejoins the fleet."""
+
+    def kill_mid_frame(c: SimCluster) -> None:
+        import io as _io
+
+        from cometbft_tpu.consensus.wal import read_frame
+
+        c.crash(DISK_VICTIM)
+        wal_path = c.root / ("node%d" % DISK_VICTIM) / "cs.wal"
+        try:
+            data = wal_path.read_bytes()
+        except OSError:
+            data = b""
+        # walk the valid frames (the WAL's own parser); cut halfway into
+        # the final one
+        f = _io.BytesIO(data)
+        pos, last_start = 0, None
+        while True:
+            _kind, _payload, reason = read_frame(f)
+            if reason is not None:
+                break
+            last_start = pos
+            pos = f.tell()
+        if last_start is not None:
+            cut = last_start + 8 + max((pos - last_start - 8) // 2, 1)
+        else:
+            cut = max(len(data) - 1, 0)
+        os.truncate(wal_path, cut)
+        c._log(
+            "scenario: tore node%d WAL mid-frame at byte %d (was %d)"
+            % (DISK_VICTIM, cut, len(data))
+        )
+
+    return [
+        Action(6.0, "kill node%d mid-frame" % DISK_VICTIM, kill_mid_frame),
+        Action(
+            20.0,
+            "restart node%d (scrub + replay)" % DISK_VICTIM,
+            lambda c: c.restart(DISK_VICTIM),
+        ),
+    ]
+
+
 SCENARIOS: dict[str, Scenario] = {
     s.name: s
     for s in [
@@ -1619,6 +1766,47 @@ SCENARIOS: dict[str, Scenario] = {
             teardown=_mesh_teardown,
         ),
         Scenario(
+            "disk-full",
+            "node1's disk fills at t=5 (injected ENOSPC): the next WAL "
+            "append fail-stops it with a typed StorageFatal BEFORE it "
+            "can vote on unpersisted state (journaled disk_fatal with "
+            "surface/errno attribution), its blackbox degrades to "
+            "counted drops, and the survivors keep agreement and reach "
+            "the target without it — byte-deterministic per seed",
+            target_height=10,
+            max_time=180.0,
+            actions=_disk_full,
+            setup=_disk_setup,
+            teardown=_disk_teardown,
+        ),
+        Scenario(
+            "disk-brownout",
+            "transient EIO bursts on the degradable blackbox surface "
+            "(t=4..10): bursts shorter than the retry budget recover "
+            "via bounded exponential backoff with zero drops; one long "
+            "burst degrades to counted drops + a disk_fault anomaly.  "
+            "No node halts, consensus never notices, agreement holds",
+            target_height=12,
+            max_time=180.0,
+            actions=_disk_brownout,
+            setup=_disk_setup,
+            teardown=_disk_teardown,
+        ),
+        Scenario(
+            "torn-wal-restart",
+            "node1 is killed mid-frame at t=6 (its WAL head cut halfway "
+            "through the final frame, the torn tail a power cut "
+            "leaves); on restart at t=20 the boot-time scrub truncates "
+            "to the last CRC-valid frame (wal_repair journaled, dropped "
+            "bytes counted), the node replays to the repaired tail and "
+            "rejoins — byte-deterministic per seed",
+            target_height=12,
+            max_time=240.0,
+            actions=_torn_wal_restart,
+            setup=_disk_setup,
+            teardown=_disk_teardown,
+        ),
+        Scenario(
             "backend-flap",
             "device backend fails in bursts of 4 with 2 clean dispatches "
             "between (t=3..14): breaker cycles open/half-open/closed on "
@@ -1717,6 +1905,13 @@ def run_scenario(
 
     _sstats.reset()
     _istats.reset()
+    # disk-fault counters are per-run too: every scenario writes WALs
+    # through the guard, and a soak row must reflect ITS run's IO alone
+    from cometbft_tpu.libs import storage_stats as _ss
+
+    _ss.reset()
+    storage_capture: dict = {}
+    fail_stopped_capture: list = []
     try:
         if scenario.setup is not None:
             scenario.setup(cluster)
@@ -1775,16 +1970,16 @@ def run_scenario(
         # (and the dump files under it) are deleted below
         tsnap = _tracer.snapshot()
         dumps = []
-        for name in tsnap["dumps"]:
+        for dump_name in tsnap["dumps"]:
             try:
-                blob = (_trace_dir / name).read_bytes()
+                blob = (_trace_dir / dump_name).read_bytes()
             except OSError:
                 continue
             import hashlib as _hashlib
 
             dumps.append(
                 {
-                    "file": name,
+                    "file": dump_name,
                     "bytes": len(blob),
                     "sha256": _hashlib.sha256(blob).hexdigest(),
                 }
@@ -1796,6 +1991,12 @@ def run_scenario(
             cluster.blackbox_stats() if cluster.blackbox else {}
         )
         postmortem_capture = list(cluster.postmortems)
+        # disk-fault capture: attached only when something actually went
+        # wrong on the storage plane (faults, retries, drops, repairs) —
+        # clean rows must not grow dead all-zero columns
+        if _ss.faulted():
+            storage_capture = _ss.snapshot()
+        fail_stopped_capture = sorted(cluster.fail_stopped)
         spans_capture = {
             "recorded": tsnap["spans_recorded"],
             "dropped": tsnap["spans_dropped"],
@@ -1843,4 +2044,6 @@ def run_scenario(
         spans=spans_capture,
         blackbox=blackbox_capture,
         postmortems=postmortem_capture,
+        storage=storage_capture,
+        fail_stopped=fail_stopped_capture,
     )

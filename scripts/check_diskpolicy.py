@@ -1,0 +1,151 @@
+"""CI lint: keep future durable IO on the diskguard seam.
+
+The disk-fault supervisor (``cometbft_tpu/libs/diskguard.py``,
+docs/storage-robustness.md) only enforces the fail-stop vs degrade
+durability policy — and only lets the sim inject deterministic storage
+faults — for writes that go THROUGH it.  A new subsystem that calls
+``open(path, "wb")`` / ``os.fsync`` / ``os.replace`` directly re-creates
+the untested-folklore problem this repo just engineered away: a durable
+surface with no policy, no injection coverage, and no metrics.
+
+This gate fails on any NEW direct durable-IO call site in production
+code (``cometbft_tpu/``) outside the seam itself:
+
+  * ``open(...)`` with a write-capable mode literal ("w", "a", "+"),
+  * ``os.fsync(...)`` (attribute form; ``f.flush()`` is fine — it is the
+    fsync that makes a write a durability promise),
+  * ``os.replace(...)`` (the atomic-publish rename).
+
+Legacy sites are PINNED at their current per-file counts (each one is a
+known quantity: WAL head management, blackbox segment files, dump
+writers, …).  Growing a pinned file's count — or adding a site anywhere
+else — is a failure: new code calls ``diskguard.file_write`` /
+``diskguard.fsync`` / ``diskguard.replace`` / ``diskguard.atomic_write``
+(or ``diskguard.guard`` around a backend-specific thunk) instead.
+AST-based like scripts/check_verify_callsites.py: comments, docstrings
+and string literals can mention the names freely.
+
+Usage (wired into gate.sh):
+    python scripts/check_diskpolicy.py [--repo-root PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import sys
+
+# the seam's own implementation layer: the one place raw durable IO and
+# the policy/injection machinery are allowed to meet
+ALLOWED_FILES = ("cometbft_tpu/libs/diskguard.py",)
+
+# Pre-diskguard direct call sites, pinned at their current counts.
+# Anything above these counts is NEW direct durable IO.
+LEGACY_MAX: dict = {}  # filled below, after the scanner definition
+
+
+def _write_mode(call: ast.Call) -> bool:
+    """True when an ``open`` call's mode literal is write-capable."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return True  # computed mode: flag it — the lint must not guess
+    return any(ch in mode.value for ch in ("w", "a", "+"))
+
+
+def _call_sites(source: str) -> "list[tuple[int, str]]":
+    """(lineno, description) for every durable-IO AST call site."""
+    hits = []
+    for node in ast.walk(ast.parse(source)):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            if _write_mode(node):
+                hits.append((node.lineno, 'open(..., "w/a/+")'))
+        elif isinstance(fn, ast.Attribute) and fn.attr in (
+            "fsync",
+            "replace",
+        ):
+            # only the os module's: str.replace / dict-like .replace on
+            # other objects must not trip the gate
+            if isinstance(fn.value, ast.Name) and fn.value.id == "os":
+                hits.append((node.lineno, f"os.{fn.attr}(...)"))
+    return sorted(hits)
+
+
+LEGACY_MAX = {
+    # CLI scaffolding written once by `cometbft-tpu init` / artifact
+    # dumps (trace/postmortem output files, not durable node state)
+    "cometbft_tpu/cmd/main.py": 5,
+    "cometbft_tpu/config/config.py": 1,
+    # consensus WAL: the guarded append/fsync path rides diskguard; the
+    # remaining sites are head-file lifecycle (open-for-append,
+    # graceful-close fsync) that predate the seam
+    "cometbft_tpu/consensus/wal.py": 2,
+    # black-box journal: head-segment open-for-append (the guarded
+    # write/flush/fsync path is on the seam)
+    "cometbft_tpu/libs/blackbox.py": 1,
+    # flight-recorder anomaly dump writer (best-effort forensics file)
+    "cometbft_tpu/libs/tracing.py": 1,
+    # native build: compiled-library publish rename
+    "cometbft_tpu/native/__init__.py": 1,
+    # node key + p2p address book JSON persisted at boot/discovery
+    "cometbft_tpu/node/nodekey.py": 1,
+    "cometbft_tpu/p2p/pex.py": 2,
+}
+
+
+def scan(repo_root: pathlib.Path) -> "list[str]":
+    """Return violation messages (empty = clean)."""
+    violations = []
+    pkg = repo_root / "cometbft_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(repo_root).as_posix()
+        if rel in ALLOWED_FILES:
+            continue
+        try:
+            hits = _call_sites(path.read_text(errors="replace"))
+        except SyntaxError as e:
+            violations.append(f"{rel}: unparsable ({e}) — cannot lint")
+            continue
+        cap = LEGACY_MAX.get(rel, 0)
+        if len(hits) > cap:
+            for lineno, line in hits:
+                violations.append(f"{rel}:{lineno}: {line}")
+            violations.append(
+                f"{rel}: {len(hits)} direct durable-IO call site(s), "
+                f"allowed {cap} — route new durable writes through "
+                "cometbft_tpu/libs/diskguard.py "
+                "(see docs/storage-robustness.md)"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo-root",
+        default=str(pathlib.Path(__file__).resolve().parent.parent),
+        help="repository root (default: this script's parent's parent)",
+    )
+    args = ap.parse_args(argv)
+    violations = scan(pathlib.Path(args.repo_root))
+    if violations:
+        print("diskpolicy: FAIL", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("diskpolicy: OK (durable IO on the diskguard seam)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

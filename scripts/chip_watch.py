@@ -33,6 +33,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)  # cometbft_tpu importable (diskguard status seam)
 LOG = os.path.join(REPO, "chipwatch.log")
 ARTIFACT = os.path.join(REPO, "BENCH_CHIPWATCH.json")
 # machine-readable availability status: nodes pointed here via
@@ -59,11 +60,14 @@ def write_status(rec: "dict | None") -> None:
         "platform": rec.get("platform") if rec else None,
         "init_s": rec.get("init_s") if rec else None,
     }
-    tmp = STATUS + ".tmp"
     try:
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-        os.replace(tmp, STATUS)
+        # diskguard seam (surface ``status``, degradable): a failed
+        # status write is a counted drop, never a dead watcher
+        from cometbft_tpu.libs import diskguard as _dg
+
+        _dg.atomic_write(
+            "status", STATUS, json.dumps(doc).encode(), do_fsync=False
+        )
     except OSError as e:
         log("status write failed: %r" % e)
 

@@ -21,43 +21,60 @@ cd "$(dirname "$0")/.."
 # gate run compiled, instead of re-tracing per process.
 export COMETBFT_TPU_EXEC_CACHE="${COMETBFT_TPU_EXEC_CACHE:-$PWD/.exec_cache}"
 
-echo "== gate 1/8: verify call-site lint =="
+echo "== gate 1/9: verify call-site + disk-policy lints =="
 python scripts/check_verify_callsites.py
+# new direct open/fsync/replace call sites must use the diskguard seam
+python scripts/check_diskpolicy.py
 
-echo "== gate 2/8: pytest =="
+echo "== gate 2/9: pytest =="
 rm -f /tmp/_gate_t1.log
 python -m pytest tests/ -x -q --durations=40 2>&1 | tee /tmp/_gate_t1.log
 python scripts/check_tier1_budget.py /tmp/_gate_t1.log
 
-echo "== gate 3/8: bench.py =="
+echo "== gate 3/9: bench.py =="
 python bench.py
 
-echo "== gate 4/8: bench.py --meshfault (elastic mesh fault isolation) =="
+echo "== gate 4/9: bench.py --meshfault (elastic mesh fault isolation) =="
 # healthy vs one-dead-chip dispatch on the per-shard host-oracle seam:
 # verdict equality, exactly one shrink, dispatch counts asserted hard;
 # refreshes BENCH_MESHFAULT.json for the trend gate below
 JAX_PLATFORMS=cpu python bench.py --meshfault
 
-echo "== gate 5/8: bench trend (BENCH_HISTORY.jsonl) =="
+echo "== gate 5/9: disk-fault robustness (diskguard) =="
+# the three storage scenarios (fail-stop halt / degrade-with-retries /
+# torn-tail repair) with invariants raised to hard failures, then the
+# bench stage: verdict equality under injected faults + same-seed trace
+# determinism of the disk-full run; refreshes BENCH_DISKFAULT.json
+JAX_PLATFORMS=cpu python -c "
+from cometbft_tpu.sim.scenarios import run_scenario
+for name in ('disk-full', 'disk-brownout', 'torn-wal-restart'):
+    r = run_scenario(name, 3, raise_on_violation=True)
+    assert r.reached, (name, r.heights)
+    print('disk scenario %-16s ok heights=%s fail_stopped=%s' % (
+        name, r.heights, r.fail_stopped))
+"
+JAX_PLATFORMS=cpu python bench.py --diskfault
+
+echo "== gate 6/9: bench trend (BENCH_HISTORY.jsonl) =="
 # re-ingests every BENCH_*.json + sim_soak trend JSON and fails on hard
 # regressions (dispatch counts, cache/occupancy ratios) beyond the noise
 # band; wall/throughput deltas stay advisory on this throttled host
 python scripts/bench_trend.py --check
 
-echo "== gate 6/8: SIGKILL forensics (black-box postmortem) =="
+echo "== gate 7/9: SIGKILL forensics (black-box postmortem) =="
 # crash a sim validator mid-round, decode its journal with the real
 # `cometbft-tpu postmortem --json` subprocess, assert the reconstructed
 # in-flight round + dispatch attribution, byte-deterministic per seed
 JAX_PLATFORMS=cpu python scripts/check_postmortem.py
 
-echo "== gate 7/8: dryrun_multichip(8) + elastic fault leg =="
+echo "== gate 8/9: dryrun_multichip(8) + elastic fault leg =="
 # includes the chip-death leg: one ordinal killed mid-run, the batch
 # must re-verify on the shrunken mesh with correct ordinal attribution
 # (COMETBFT_TPU_DRYRUN_FAULT=0 skips the leg)
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== gate 8/8: native sanitizers (TSAN+ASAN) =="
+echo "== gate 9/9: native sanitizers (TSAN+ASAN) =="
 bash scripts/sanitize_native.sh
 
 if [ "${NIGHTLY:-0}" = "1" ]; then

@@ -89,6 +89,22 @@ def _row_extra(row: dict) -> str:
         )
     if row.get("rotations"):
         extra += " rot=%d" % row["rotations"]
+    disk = row.get("storage") or {}
+    if disk:
+        # disk-fault scenarios (libs/diskguard): injected faults, retry
+        # recoveries, counted drops, fail-stop halts and boot-time WAL
+        # tail repairs — the storage-plane verdict at a glance
+        extra += " disk[inj=%d rt=%d dr=%d fatal=%d rp=%d]" % (
+            disk.get("injected", 0),
+            disk.get("retries", 0),
+            disk.get("drops", 0),
+            disk.get("fatals", 0),
+            disk.get("repairs", 0),
+        )
+        if disk.get("fail_stopped_nodes"):
+            extra += " failstop=%s" % ",".join(
+                str(n) for n in disk["fail_stopped_nodes"]
+            )
     bb = row.get("blackbox") or {}
     if bb:
         # black-box journal shape of the run: bytes on disk and (above

@@ -826,3 +826,129 @@ class TestDeviceHealth:
             assert "cometbft_device_up 1" in page
         finally:
             device_health.reset()
+
+
+class TestDiskFaultDegradation:
+    """Satellite (docs/storage-robustness.md): injected ENOSPC/EIO into
+    the journal writer must degrade to counted drops — never kill the
+    writer thread — and the kill switch path must stay untouched."""
+
+    @pytest.fixture(autouse=True)
+    def _guard(self, monkeypatch, tmp_path):
+        from cometbft_tpu.libs import diskguard as dg
+        from cometbft_tpu.libs import storage_stats
+
+        monkeypatch.setenv(
+            "COMETBFT_TPU_TRACE_DIR", str(tmp_path / "flight")
+        )
+        prev = dg.set_fault_plan(None)
+        dg.set_sleeper(lambda _s: None)
+        storage_stats.reset()
+        tracing.reset_tracer()
+        yield
+        dg.set_fault_plan(prev)
+        dg.set_sleeper(None)
+        storage_stats.reset()
+        tracing.reset_tracer()
+
+    def test_enospc_degrades_to_counted_drops_writer_survives(
+        self, tmp_path
+    ):
+        import errno
+
+        from cometbft_tpu.libs import diskguard as dg
+
+        j = blackbox.BlackboxJournal(
+            str(tmp_path / "bb"), threaded=True, clock=lambda: 1.0,
+            flush_every=1,
+        )
+        plan = dg.FaultPlan()
+        rule = plan.add(surface="blackbox", err=errno.ENOSPC)
+        dg.set_fault_plan(plan)
+        for i in range(8):
+            j.on_anomaly("storm", {"i": i}, float(i))  # fsync path
+        dg.set_fault_plan(None)
+        faulted = j.stats()
+        assert faulted["dropped"] > 0, "ENOSPC must be a counted drop"
+        assert rule.seen > 0, "the injector really fired"
+        assert j._writer is not None and j._writer.is_alive(), (
+            "writer thread must survive a full disk"
+        )
+        # the guard journaled the failure as a disk_fault anomaly
+        anomalies = tracing.get_tracer().snapshot()["anomalies"]
+        assert anomalies.get("disk_fault", 0) > 0
+        # disk healed: later records land again
+        before = j.stats()["records"]
+        j.on_anomaly("after-heal", {}, 9.0)
+        j.close(clean=True)
+        healed = j.stats()
+        assert healed["records"] >= before + 2  # record + sentinel
+        recs, _stats = blackbox.decode_dir(j.dir)
+        assert recs[-1][0] == blackbox.REC_CLEAN_CLOSE
+
+    def test_transient_eio_retries_recover_without_drops(self, tmp_path):
+        import errno
+
+        from cometbft_tpu.libs import diskguard as dg
+        from cometbft_tpu.libs import storage_stats
+
+        j = blackbox.BlackboxJournal(
+            str(tmp_path / "bb"), threaded=False, clock=lambda: 1.0,
+            flush_every=1,
+        )
+        plan = dg.FaultPlan()
+        plan.add(surface="blackbox", err=errno.EIO, count=2)
+        dg.set_fault_plan(plan)
+        j.on_event("breaker_close", {"backend": "xla"})
+        j.close(clean=True)
+        assert j.stats()["dropped"] == 0, "short burst must recover"
+        snap = storage_stats.snapshot()["surfaces"]["blackbox"]
+        assert snap["retries"] == 2 and snap["drops"] == 0
+        recs, stats = blackbox.decode_dir(j.dir)
+        assert stats["corrupt_skipped"] == 0
+        assert [k for k, _ in recs][-1] == blackbox.REC_CLEAN_CLOSE
+
+    def test_flush_failure_does_not_double_count_frame(self, tmp_path):
+        """A frame whose WRITE landed but whose flush/fsync failed is
+        counted as written, not dropped: records + dropped must never
+        exceed frames submitted (the soak/postmortem columns depend on
+        that arithmetic)."""
+        import errno
+
+        from cometbft_tpu.libs import diskguard as dg
+
+        j = blackbox.BlackboxJournal(
+            str(tmp_path / "bb"), threaded=False, clock=lambda: 1.0,
+            flush_every=1,
+        )
+        base = j.stats()["records"]
+        plan = dg.FaultPlan()
+        # fail ONLY the flush op: the write itself succeeds
+        plan.add(surface="blackbox", op="flush", err=errno.ENOSPC)
+        dg.set_fault_plan(plan)
+        j.on_event("breaker_close", {"backend": "xla"})  # one frame
+        dg.set_fault_plan(None)
+        s = j.stats()
+        assert s["records"] == base + 1, "the write landed"
+        assert s["dropped"] == 0, "a failed flush is not a dropped frame"
+        j.close(clean=True)
+
+    def test_kill_switch_paths_untouched(self, monkeypatch, tmp_path):
+        """COMETBFT_TPU_BLACKBOX=0: no journal opens, so the guard sees
+        zero blackbox traffic even with a hostile fault plan active."""
+        import errno
+
+        from cometbft_tpu.libs import diskguard as dg
+        from cometbft_tpu.libs import storage_stats
+
+        monkeypatch.setenv("COMETBFT_TPU_BLACKBOX", "0")
+        plan = dg.FaultPlan()
+        rule = plan.add(surface="blackbox", err=errno.ENOSPC)
+        dg.set_fault_plan(plan)
+        assert blackbox.open_journal(str(tmp_path / "bb")) is None
+        tracing.record_anomaly("whatever", x=1)
+        assert rule.seen == 0
+        assert (
+            "blackbox" not in storage_stats.snapshot()["surfaces"]
+        )
+        assert not os.path.exists(str(tmp_path / "bb" / blackbox.HEAD_NAME))

@@ -133,21 +133,22 @@ class TestReindexEvent:
         assert height >= 1
 
         # wipe the index by pruning it completely, then reindex offline
+        # (the index lives in its own tx_index.db since the surface split)
         from cometbft_tpu.libs.pubsub import Query
         from cometbft_tpu.indexer import KVBlockIndexer, KVTxIndexer
         from cometbft_tpu.store.kv import SqliteKV
 
-        db_path = os.path.join(home, cfg.base.db_dir, "chain.db")
-        db = SqliteKV(db_path)
-        KVTxIndexer(db).prune(height + 1)
-        KVBlockIndexer(db).prune(height + 1)
-        assert KVTxIndexer(db).search(Query.parse("tx.height>0")) == []
-        db.close()
+        index_path = os.path.join(home, cfg.base.db_dir, "tx_index.db")
+        idx = SqliteKV(index_path, surface="indexer")
+        KVTxIndexer(idx).prune(height + 1)
+        KVBlockIndexer(idx).prune(height + 1)
+        assert KVTxIndexer(idx).search(Query.parse("tx.height>0")) == []
+        idx.close()
 
         rc = cli_main(["--home", home, "reindex-event"])
         assert rc == 0
 
-        db = SqliteKV(db_path)
-        found = KVTxIndexer(db).search(Query.parse("tx.height>0"))
-        db.close()
+        idx = SqliteKV(index_path, surface="indexer")
+        found = KVTxIndexer(idx).search(Query.parse("tx.height>0"))
+        idx.close()
         assert len(found) == 1 and found[0].tx == b"rk=rv"

@@ -163,16 +163,43 @@ def test_wal_write_replay_and_corruption(tmp_path):
     assert wal2.replay_after_height(1) == [b"msg-3", b"msg-4"]
     wal2.close()
 
-    # corrupt the tail: non-strict replay stops at corruption
+    # corrupt the tail: reopening auto-repairs by truncating to the last
+    # CRC-valid frame (docs/storage-robustness.md), so even STRICT replay
+    # survives — msg-4 is lost either way, and the repair is journaled
     with open(path, "r+b") as f:
         f.seek(-3, os.SEEK_END)
         f.write(b"\xff\xff\xff")
+    torn_size = os.path.getsize(path)
     wal3 = WAL(path)
     msgs = wal3.replay_after_height(1)
     assert msgs == [b"msg-3"]  # msg-4 lost to corruption, msg-3 survives
-    with pytest.raises(WALCorruptionError):
-        list(wal3.iter_records(strict=True))
+    recs = list(wal3.iter_records(strict=True))  # repaired: no longer fatal
+    assert [r.payload for r in recs if r.kind == 1] == [
+        b"msg-1", b"msg-2", b"msg-3",
+    ]
+    assert wal3.last_repair is not None
+    assert wal3.last_repair["dropped_bytes"] == torn_size - os.path.getsize(path)
     wal3.close()
+
+
+def test_wal_kill_switch_restores_strict_corruption(tmp_path, monkeypatch):
+    """COMETBFT_TPU_DISKGUARD=0 disables the boot-time tail repair: a
+    torn tail stays on disk and strict replay is fatal, bit-for-bit the
+    pre-diskguard behavior."""
+    monkeypatch.setenv("COMETBFT_TPU_DISKGUARD", "0")
+    path = str(tmp_path / "wal.log")
+    wal = WAL(path)
+    wal.write(b"msg-1")
+    wal.write_sync(b"msg-2")
+    wal.close()
+    with open(path, "r+b") as f:
+        f.seek(-3, os.SEEK_END)
+        f.write(b"\xff\xff\xff")
+    wal2 = WAL(path)
+    assert wal2.last_repair is None
+    with pytest.raises(WALCorruptionError):
+        list(wal2.iter_records(strict=True))
+    wal2.close()
 
 
 def test_wal_rotation(tmp_path):
@@ -290,3 +317,261 @@ def test_file_pv_double_sign_protection(tmp_path):
     )
     pv2.sign_vote(CHAIN_ID, nxt)
     assert nxt.signature
+
+
+def test_wal_repair_torn_at_every_byte_offset(tmp_path):
+    """The corrupt-tail scrub must recover from a final frame torn at
+    EVERY byte offset: records before it replay (strictly), the repair
+    is recorded, and the repaired WAL accepts new appends."""
+    path = str(tmp_path / "wal.log")
+    wal = WAL(path)
+    wal.write_sync(b"keep-1")
+    wal.write_sync(b"keep-2")
+    full_before = os.path.getsize(path)
+    wal.write_sync(b"the-final-frame")
+    wal.close()
+    full = os.path.getsize(path)
+    blob = open(path, "rb").read()
+    for cut in range(full_before + 1, full):
+        torn = str(tmp_path / f"torn-{cut}.log")
+        with open(torn, "wb") as f:
+            f.write(blob[:cut])
+        w = WAL(torn)
+        assert w.last_repair is not None, cut
+        assert w.last_repair["dropped_bytes"] == cut - full_before
+        recs = [r.payload for r in w.iter_records(strict=True)]
+        assert recs == [b"keep-1", b"keep-2"], cut
+        # the repaired head accepts appends and replays them strictly
+        w.write_sync(b"after-repair")
+        recs = [r.payload for r in w.iter_records(strict=True)]
+        assert recs == [b"keep-1", b"keep-2", b"after-repair"], cut
+        w.close()
+
+
+def test_file_pv_truncated_state_file_fail_stops(tmp_path):
+    """A TORN last-sign state file must be a typed fail-stop, never a
+    silent fresh-state fallback (double-sign hazard)."""
+    from cometbft_tpu.privval.file_pv import PrivValStateError
+
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp)
+    blob = open(sp, "rb").read()
+    with open(sp, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # torn mid-document
+    with pytest.raises(PrivValStateError):
+        FilePV.load(kp, sp)
+    with pytest.raises(PrivValStateError):
+        FilePV.load_or_generate(kp, sp)
+    # the state file was NOT clobbered by a fresh fallback
+    assert open(sp, "rb").read() == blob[: len(blob) // 2]
+    del pv
+
+
+def test_file_pv_garbage_state_file_fail_stops(tmp_path):
+    from cometbft_tpu.privval.file_pv import PrivValStateError
+
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    FilePV.generate(kp, sp)
+    for garbage in (b"not json at all", b"{}", b'{"height": "NaNs"}'):
+        with open(sp, "wb") as f:
+            f.write(garbage)
+        with pytest.raises(PrivValStateError):
+            FilePV.load(kp, sp)
+
+
+def test_file_pv_fail_stop_error_is_storage_fatal(tmp_path):
+    """PrivValStateError rides the diskguard StorageFatal hierarchy, so
+    the consensus fail-stop seam treats both uniformly."""
+    from cometbft_tpu.libs.diskguard import StorageFatal
+    from cometbft_tpu.privval.file_pv import PrivValStateError
+
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    FilePV.generate(kp, sp)
+    with open(sp, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(StorageFatal):
+        FilePV.load(kp, sp)
+    assert issubclass(PrivValStateError, StorageFatal)
+
+
+def test_file_pv_valid_state_still_loads(tmp_path):
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp)
+    pv._state.height = 7
+    pv._save_state()
+    again = FilePV.load(kp, sp)
+    assert again._state.height == 7
+
+
+def test_legacy_index_migration_moves_keys_out_of_chain_db(tmp_path):
+    """Pre-split data dirs kept the tx/block index inside chain.db; the
+    boot-time migration drains it into the dedicated tx_index.db so
+    tx_search keeps seeing pre-split heights — idempotently, with chain
+    data untouched, and with key bodies containing 0xff (raw hashes)."""
+    from cometbft_tpu.indexer.kv import (
+        _BLOCK_EVENT,
+        _TX_EVENT,
+        _TX_PRIMARY,
+        migrate_legacy_index,
+    )
+
+    chain = SqliteKV(str(tmp_path / "chain.db"), surface="state")
+    index = SqliteKV(str(tmp_path / "tx_index.db"), surface="indexer")
+    legacy = [
+        (_TX_PRIMARY + b"\xff" * 8, b"rec-ff"),  # 0xff-heavy hash body
+        (_TX_PRIMARY + b"\x00abc", b"rec-0"),
+        (_TX_EVENT + b"tx.height/3/" + b"\x00" * 12, b"h"),
+        (_BLOCK_EVENT + b"block.height/3/" + b"\x00" * 8, b""),
+    ]
+    chain.write_batch(legacy + [(b"H:1", b"block-bytes")], [])
+    assert migrate_legacy_index(chain, index) == len(legacy)
+    for k, v in legacy:
+        assert index.get(k) == v, "index entry must move across"
+        assert chain.get(k) is None, "chain.db must stop hoarding it"
+    assert chain.get(b"H:1") == b"block-bytes"  # chain data untouched
+    # steady state: nothing left to move
+    assert migrate_legacy_index(chain, index) == 0
+    chain.close()
+    index.close()
+
+
+def test_legacy_index_migration_delete_failure_degrades(tmp_path):
+    """The drain's chain.db deletes are INDEX maintenance: an IO failure
+    there must follow the degradable indexer policy (counted drop, no
+    storage-fatal latch on a node that then keeps running) and leave a
+    state the next boot's drain completes."""
+    import errno
+
+    from cometbft_tpu.indexer.kv import _TX_PRIMARY, migrate_legacy_index
+    from cometbft_tpu.libs import diskguard as dg
+    from cometbft_tpu.libs import storage_stats
+
+    storage_stats.reset()
+    dg.set_sleeper(lambda _s: None)
+    chain = SqliteKV(str(tmp_path / "chain.db"), surface="state")
+    index = SqliteKV(str(tmp_path / "tx_index.db"), surface="indexer")
+    chain.write_batch([(_TX_PRIMARY + b"h1", b"rec")], [])
+    plan = dg.FaultPlan()
+    # fire on the chain.db delete batch only (the copy targets tx_index)
+    plan.add(
+        surface="indexer", op="write_batch", path_substr="chain.db",
+        err=errno.ENOSPC,
+    )
+    dg.set_fault_plan(plan)
+    try:
+        with pytest.raises(OSError) as ei:
+            migrate_legacy_index(chain, index)
+        assert not isinstance(ei.value, dg.StorageFatal)
+        snap = storage_stats.snapshot()
+        assert not snap["totals"]["fatal"], "no fatal latch for a drain"
+        assert snap["surfaces"]["indexer"]["drops"] == 1
+        # the copy landed before the failed delete: resumable, not lost
+        assert index.get(_TX_PRIMARY + b"h1") == b"rec"
+        assert chain.get(_TX_PRIMARY + b"h1") == b"rec"
+    finally:
+        dg.set_fault_plan(None)
+        dg.set_sleeper(None)
+        storage_stats.reset()
+    assert migrate_legacy_index(chain, index) == 1  # next boot finishes
+    assert chain.get(_TX_PRIMARY + b"h1") is None
+    chain.close()
+    index.close()
+
+
+def test_wal_zero_filled_tail_repaired_at_open(tmp_path):
+    """8+ zero bytes pass the frame CRC check (crc32(b'')==0) but carry
+    no record — the canonical ext4 post-crash artifact.  The boot scrub
+    must truncate it like any other torn tail, not crash the open."""
+    p = str(tmp_path / "wal")
+    w = WAL(p)
+    w.write_sync(b"hello")
+    w.write_sync(b"world")
+    w.close()
+    good = os.path.getsize(p)
+    for pad in (8, 20):
+        with open(p, "ab") as f:
+            f.write(b"\x00" * pad)
+        w2 = WAL(p)  # must not raise
+        assert w2.last_repair["good_bytes"] == good
+        assert w2.last_repair["dropped_bytes"] == pad
+        assert [r.payload for r in w2.iter_records(strict=True)] == [
+            b"hello",
+            b"world",
+        ]
+        w2.close()
+
+
+def test_wal_midstream_corruption_fail_stops_instead_of_truncating(tmp_path):
+    """A CRC-bad frame with valid frames AFTER it is mid-stream damage,
+    not a torn tail: truncating would silently discard durable
+    (possibly fsync'd) records, so the open must keep the pre-repair
+    fail-fast — typed error, file left untouched as evidence."""
+    from cometbft_tpu.libs import storage_stats
+
+    p = str(tmp_path / "wal")
+    w = WAL(p)
+    w.write_sync(b"keep-1")
+    end_first = os.path.getsize(p)
+    w.write_sync(b"middle-frame")
+    w.write_sync(b"fsyncd-after-damage")
+    w.close()
+    blob = bytearray(open(p, "rb").read())
+    blob[end_first + 12] ^= 0xFF  # bit-flip inside the middle frame body
+    with open(p, "wb") as f:
+        f.write(blob)
+    storage_stats.reset()
+    try:
+        with pytest.raises(WALCorruptionError, match="mid-stream"):
+            WAL(p)
+        # evidence preserved: not truncated, not rewritten
+        assert open(p, "rb").read() == bytes(blob)
+        # attributed like any other fail-stop storage failure
+        snap = storage_stats.snapshot()
+        assert snap["surfaces"]["wal"]["fatals"] == 1
+        assert snap["totals"]["fatal"] is True
+    finally:
+        storage_stats.reset()
+
+
+def test_inspect_union_kv_serves_partially_migrated_index(tmp_path):
+    """An interrupted boot-time migration leaves some legacy keys in
+    chain.db; the union view (node + inspect indexer reads) must serve
+    both halves, with tx_index.db shadowing duplicates and b'' values
+    preserved, and writes routed to the primary only."""
+    from cometbft_tpu.store.kv import UnionKV as _UnionKV
+
+    chain = SqliteKV(str(tmp_path / "chain.db"), surface="state")
+    index = SqliteKV(str(tmp_path / "tx_index.db"), surface="indexer")
+    chain.write_batch(
+        [(b"txh/legacy", b"old-rec"), (b"bhe/h/1", b""), (b"dup", b"old")],
+        [],
+    )
+    index.write_batch([(b"txh/new", b"new-rec"), (b"dup", b"new")], [])
+    u = _UnionKV(index, chain, fallback_surface="indexer")
+    assert u.get(b"txh/legacy") == b"old-rec"  # still only in chain.db
+    assert u.get(b"txh/new") == b"new-rec"
+    assert u.get(b"bhe/h/1") == b""            # empty value is a value
+    assert u.get(b"dup") == b"new"             # primary shadows fallback
+    assert u.get(b"missing") is None
+    assert list(u.iterate(b"txh/", b"txh0")) == [
+        (b"txh/legacy", b"old-rec"),
+        (b"txh/new", b"new-rec"),
+    ]
+    assert [k for k, _ in u.iterate()] == [
+        b"bhe/h/1", b"dup", b"txh/legacy", b"txh/new",
+    ]
+    # deletes reach BOTH halves: a legacy row pruned through the union
+    # must not survive in chain.db for the next boot's drain to
+    # resurrect into tx_index.db (un-pruning it permanently)
+    u.delete(b"txh/legacy")
+    assert u.get(b"txh/legacy") is None
+    assert chain.get(b"txh/legacy") is None
+    u.write_batch([], [b"bhe/h/1", b"dup"])
+    assert u.get(b"bhe/h/1") is None
+    assert chain.get(b"dup") is None
+    from cometbft_tpu.indexer.kv import migrate_legacy_index as _drain
+
+    assert _drain(chain, index) == 0  # nothing left to resurrect
+    assert index.get(b"txh/legacy") is None
+    chain.close()
+    index.close()

@@ -1071,3 +1071,107 @@ class TestByzantineVoter:
         assert a.trace == b.trace
         assert a.heights == b.heights
         assert a.evidence == b.evidence
+
+
+class TestDiskFaultScenarios:
+    """Storage-plane robustness (docs/storage-robustness.md): fail-stop
+    halts, degrade-with-retries, and torn-tail boot repair driven by the
+    deterministic diskguard injector."""
+
+    def test_disk_full_fail_stops_victim_survivors_agree(self, tmp_path):
+        from cometbft_tpu.sim.scenarios import DISK_VICTIM
+
+        res = run_scenario(
+            "disk-full", 7, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"survivors stalled: {res.heights}"
+        assert not res.violations
+        # the victim fail-stopped: halted, zero participation after
+        assert res.fail_stopped == [DISK_VICTIM]
+        assert res.heights[DISK_VICTIM] == -1
+        # survivors all reached the target (agreement checker green)
+        for i, h in enumerate(res.heights):
+            if i != DISK_VICTIM and i < res.n_vals:
+                assert h >= res.target_height, res.heights
+        totals = res.storage["totals"]
+        assert totals["fatals"] == 1, totals           # one halted WAL
+        assert totals["drops"] >= 1, totals            # blackbox degraded
+        surfaces = res.storage["surfaces"]
+        assert surfaces["wal"]["fatals"] == 1
+        assert surfaces["blackbox"]["fatals"] == 0     # degrade, never halt
+        # anomaly attribution: the fail-stop journaled disk_fatal
+        anomalies = res.spans["anomalies"]
+        assert anomalies.get("disk_fatal", 0) == 1, anomalies
+        assert anomalies.get("disk_fault", 0) >= 1, anomalies
+        # the halt is visible in the trace with surface/op attribution
+        assert any("STORAGE FATAL" in line for line in res.trace)
+        row = res.summary()
+        assert row["storage"]["fail_stopped_nodes"] == [DISK_VICTIM]
+
+    def test_disk_brownout_retries_recover_no_halt(self, tmp_path):
+        res = run_scenario(
+            "disk-brownout", 7, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached and not res.violations
+        assert res.fail_stopped == []
+        assert all(h >= res.target_height for h in res.heights)
+        totals = res.storage["totals"]
+        # three short bursts recovered via retries; the long burst
+        # degraded to counted drops; nothing fail-stopped
+        assert totals["retries"] >= 6, totals
+        assert totals["drops"] >= 1, totals
+        assert totals["fatals"] == 0, totals
+        assert res.spans["anomalies"].get("disk_fault", 0) >= 1
+
+    def test_torn_wal_restart_repairs_and_rejoins(self, tmp_path):
+        from cometbft_tpu.sim.scenarios import DISK_VICTIM
+
+        res = run_scenario(
+            "torn-wal-restart", 7, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"victim never rejoined: {res.heights}"
+        assert not res.violations
+        assert res.fail_stopped == []
+        # the victim is back at (or past) the target after the repair
+        assert res.heights[DISK_VICTIM] >= res.target_height
+        totals = res.storage["totals"]
+        assert totals["repairs"] == 1, totals
+        assert totals["repaired_bytes"] > 0, totals
+        assert totals["fatals"] == 0, totals
+        # the repair is logged with byte attribution and journaled into
+        # the victim's fresh black box
+        repair_lines = [l for l in res.trace if "wal_repair" in l]
+        assert len(repair_lines) == 1, res.trace[-20:]
+        assert "node%d" % DISK_VICTIM in repair_lines[0]
+        # the victim's pre-crash journal decoded as an unclean shutdown
+        assert res.postmortems, "no postmortem captured at restart"
+        assert res.postmortems[0]["node"] == DISK_VICTIM
+        assert res.postmortems[0]["report"]["unclean_shutdown"] is True
+
+    @pytest.mark.slow
+    def test_disk_scenarios_deterministic(self, tmp_path):
+        import json as _json
+
+        for name in ("disk-full", "disk-brownout", "torn-wal-restart"):
+            a = run_scenario(name, 17, root=tmp_path / (name + "-a"))
+            b = run_scenario(name, 17, root=tmp_path / (name + "-b"))
+            assert a.trace == b.trace, name
+            assert a.heights == b.heights, name
+            assert _json.dumps(a.summary(), sort_keys=True) == _json.dumps(
+                b.summary(), sort_keys=True
+            ), name
+
+    def test_diskguard_kill_switch_restores_behavior(
+        self, tmp_path, monkeypatch
+    ):
+        """COMETBFT_TPU_DISKGUARD=0: the injector never fires (a hostile
+        plan is a no-op), no storage stats are recorded, and the run is
+        a plain baseline."""
+        monkeypatch.setenv("COMETBFT_TPU_DISKGUARD", "0")
+        res = run_scenario(
+            "disk-full", 7, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached and not res.violations
+        assert res.fail_stopped == []          # nobody halted
+        assert all(h >= res.target_height for h in res.heights)
+        assert res.storage == {}               # guard fully bypassed
