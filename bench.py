@@ -1193,6 +1193,129 @@ def run_meshfault(emit, n=256, reps=3, width=4) -> dict:
     return rec
 
 
+def run_proofserve(
+    emit, n_queries=10000, n_heights=32, txs_per_block=64, sample=2000
+) -> dict:
+    """Coalesced proof-serving stage (docs/proof-serving.md).  A fake
+    in-memory chain of ``n_heights`` blocks x ``txs_per_block`` txs is
+    served two ways, both on the host tree-runner seam so the stage is
+    jax-free, deterministic, and platform-independent:
+
+      * **coalesced leg** — ``n_queries`` tx-proof queries through a
+        ``ProofServer`` in paused bursts: each burst flushes as ONE
+        dispatch group per height, and the LRU cache absorbs repeats,
+        so tree builds stay near ``n_heights`` no matter how many
+        queries arrive;
+      * **serial leg** — a ``sample``-sized subset served the
+        pre-plane way: one full ``merkle.proofs_from_byte_slices``
+        tree build per query.
+
+    Asserted hard: roots and proofs bitwise-equal between the two
+    legs, and coalesced dispatches-per-1k-proofs strictly below
+    serial (which is 1000 by construction).  Walls are advisory.
+    Emitted as stage="proofserve" and written to BENCH_PROOFSERVE.json
+    for the bench_trend gate."""
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.ops import sha256_tree
+    from cometbft_tpu.proofserve import service as psvc
+    from cometbft_tpu.proofserve import stats as pstats
+
+    # deterministic fake chain: height h -> txs_per_block distinct txs
+    chain = {
+        h: [
+            b"ps-tx-%d-%d-" % (h, i) + bytes([h & 0xFF, i & 0xFF]) * 8
+            for i in range(txs_per_block)
+        ]
+        for h in range(1, n_heights + 1)
+    }
+
+    def tx_loader(height: int):
+        return chain.get(height)
+
+    heights = [1 + (i % n_heights) for i in range(n_queries)]
+    burst = max(n_heights * 4, 512)
+
+    sha256_tree.set_tree_runner(sha256_tree.host_tree_runner)
+    server = psvc.ProofServer(
+        tx_loader, lambda h: None, lambda h: None, queue_cap=burst
+    )
+    pstats.reset()
+    responses: "dict[int, tuple]" = {}
+    try:
+        t0 = time.perf_counter()
+        for start in range(0, n_queries, burst):
+            hs = heights[start : start + burst]
+            server.pause()
+            futs = [server.submit("tx", h) for h in hs]
+            server.resume()
+            for h, f in zip(hs, futs):
+                root, proofs = f.result(timeout=60)
+                responses[h] = (root, proofs)
+        coalesced_wall = time.perf_counter() - t0
+        snap = pstats.snapshot()
+    finally:
+        server.close()
+        sha256_tree.clear_tree_runner()
+
+    builds = snap["tree_builds_total"]
+    assert snap["shed_total"] == 0, snap
+    assert len(responses) == n_heights
+
+    # serial leg: one full tree build per query, bitwise-compared
+    step = max(1, n_queries // sample)
+    serial_n = 0
+    t0 = time.perf_counter()
+    for i in range(0, n_queries, step):
+        h = heights[i]
+        root, proofs = merkle.proofs_from_byte_slices(chain[h])
+        serial_n += 1
+        croot, cproofs = responses[h]
+        assert root == croot, f"root diverged at height {h}"
+        for p, cp in zip(proofs, cproofs):
+            assert (
+                p.total == cp.total
+                and p.index == cp.index
+                and p.leaf_hash == cp.leaf_hash
+                and p.aunts == cp.aunts
+            ), f"proof diverged at height {h} index {p.index}"
+    serial_wall = time.perf_counter() - t0
+
+    coalesced_per_1k = 1000.0 * builds / n_queries
+    serial_per_1k = 1000.0  # one tree build per query, by construction
+    rec = {
+        "metric": "proofserve_coalescing",
+        "stage": "proofserve",
+        "queries": n_queries,
+        "heights": n_heights,
+        "txs_per_block": txs_per_block,
+        "tree_builds": builds,
+        "cache_hits": snap["cache_hits_total"],
+        "queries_per_flush": snap["queries_per_flush"],
+        "dispatches_per_1k_proofs_coalesced": round(coalesced_per_1k, 3),
+        "dispatches_per_1k_proofs_serial": round(serial_per_1k, 3),
+        "coalesced_wall_s": round(coalesced_wall, 3),
+        "coalesced_proofs_per_s_advisory": round(
+            n_queries / coalesced_wall, 1
+        ),
+        "serial_sample": serial_n,
+        "serial_wall_s": round(serial_wall, 3),
+        "serial_proofs_per_s_advisory": round(serial_n / serial_wall, 1),
+    }
+    emit(rec)
+    assert coalesced_per_1k < serial_per_1k, (
+        "coalesced proof serving must beat per-query serial serving: "
+        f"{coalesced_per_1k} >= {serial_per_1k} dispatches/1k proofs"
+    )
+    out = os.path.join(REPO, "BENCH_PROOFSERVE.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return rec
+
+
 def run_diskfault(emit, n=128, seed=11) -> dict:
     """Disk-fault supervisor stage (docs/storage-robustness.md).  Two
     legs, both deterministic and platform-independent:
@@ -2183,6 +2306,18 @@ def main() -> None:
         "BENCH_MESHFAULT_BATCH / _WIDTH size the run",
     )
     ap.add_argument(
+        "--proofserve",
+        action="store_true",
+        help="run only the coalesced proof-serving stage: N tx-proof "
+        "queries through the proofserve ProofServer (paused-burst "
+        "flushes + LRU cache) vs per-query serial tree builds on the "
+        "host tree-runner seam — roots/proofs bitwise-equal and "
+        "dispatches-per-1k-proofs asserted hard, walls advisory; "
+        "writes BENCH_PROOFSERVE.json for the bench_trend gate; "
+        "BENCH_PROOFSERVE_QUERIES / _HEIGHTS / _TXS / _SAMPLE size "
+        "the run",
+    )
+    ap.add_argument(
         "--diskfault",
         action="store_true",
         help="run only the disk-fault supervisor stage: verify verdicts "
@@ -2283,6 +2418,16 @@ def main() -> None:
             _emit,
             n=int(os.environ.get("BENCH_MESHFAULT_BATCH", "256")),
             width=int(os.environ.get("BENCH_MESHFAULT_WIDTH", "4")),
+        )
+    elif args.proofserve:
+        # jax-free by construction (host tree-runner seam): no
+        # compilation cache plumbing needed
+        run_proofserve(
+            _emit,
+            n_queries=int(os.environ.get("BENCH_PROOFSERVE_QUERIES", "10000")),
+            n_heights=int(os.environ.get("BENCH_PROOFSERVE_HEIGHTS", "32")),
+            txs_per_block=int(os.environ.get("BENCH_PROOFSERVE_TXS", "64")),
+            sample=int(os.environ.get("BENCH_PROOFSERVE_SAMPLE", "2000")),
         )
     elif args.diskfault:
         run_diskfault(

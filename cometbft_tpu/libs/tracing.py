@@ -1014,6 +1014,11 @@ def trace_document(
 
         return storage_stats.snapshot()
 
+    def _proofserve():
+        from cometbft_tpu.proofserve import stats as pstats
+
+        return pstats.snapshot()
+
     section("backend", _backend)
     section("sigcache", _sigcache)
     section("dispatch", _dispatch)
@@ -1023,4 +1028,5 @@ def trace_document(
     section("device", _device)
     section("blackbox", _blackbox)
     section("storage", _storage)
+    section("proofserve", _proofserve)
     return doc

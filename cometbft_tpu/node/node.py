@@ -561,6 +561,17 @@ class Node(BaseService):
             from cometbft_tpu.rpc.core import Environment
             from cometbft_tpu.rpc.server import RPCServer
 
+            # proof-serving coalescer for light-client read traffic
+            # (docs/proof-serving.md): store-backed loaders, decoupled
+            # from the RPC handlers that ride it
+            from cometbft_tpu import proofserve
+
+            if proofserve.enabled():
+                proofserve.configure(
+                    self._proof_tx_loader,
+                    self._proof_header_hasher,
+                    self._proof_valset_hasher,
+                )
             env = Environment(self)
             self.rpc_server = RPCServer(self.config.rpc, env, self.event_bus)
             self.rpc_server.start()
@@ -691,9 +702,31 @@ class Node(BaseService):
                 ev.clear()
                 self.consensus.notify_txs_available()
 
+    # -- proof-server loaders (proofserve.configure at start) --------------
+
+    def _proof_tx_loader(self, height: int):
+        blk = self.block_store.load_block(int(height))
+        return None if blk is None else list(blk.data.txs)
+
+    def _proof_header_hasher(self, height: int):
+        meta = self.block_store.load_block_meta(int(height))
+        return None if meta is None else meta.header.hash()
+
+    def _proof_valset_hasher(self, height: int):
+        try:
+            vals = self.state_store.load_validators(int(height))
+        except Exception:  # noqa: BLE001 — pruned/unknown height
+            return None
+        return None if vals is None else vals.hash()
+
     def on_stop(self) -> None:
         if self.switch is not None:
             self.switch.stop()
+        from cometbft_tpu import proofserve
+
+        # drain the proof coalescer before servers close: a future handed
+        # to an RPC thread must resolve even across shutdown
+        proofserve.reset_server()
         if self.tx_ingest is not None:
             # drain queued gossip into the mempool before the proxy closes
             self.tx_ingest.close()

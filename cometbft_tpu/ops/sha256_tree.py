@@ -1,0 +1,502 @@
+"""Vectorized SHA-256 Merkle tree-hash kernel (RFC 6962 layout).
+
+``crypto/merkle.py`` is serial host Python: one ``hashlib`` call per node,
+which is fine for a 14-field header and hopeless for serving inclusion
+proofs to a million light clients (ROADMAP item 3).  This module hashes a
+whole leaf set in one bucket-padded device pass and then reduces the tree
+layer by layer — the SHA-512 bucket machinery of ``ops/verify.py`` applied
+to SHA-256:
+
+  * **leaf kernel** — every leaf is padded on the host (domain prefix
+    ``0x00``, SHA-256 padding) into a ``(blocks, lanes, 16)`` uint32 word
+    tensor; the kernel scans the message blocks with per-lane masking
+    (``block < n_blocks``), so ONE executable per (lanes, blocks) bucket
+    serves any mix of leaf lengths;
+  * **layer kernel** — digests are paired adjacently and hashed with the
+    ``0x01`` inner prefix (a fixed 2-block message built from digest words,
+    no byte shuffling on the host); an odd tail is promoted unchanged.
+    The output keeps the input's lane count (valid prefix ``ceil(k/2)``),
+    so ONE executable per lanes bucket serves EVERY level of the tree.
+
+Bottom-up adjacent pairing with odd-tail promotion is structurally
+equivalent to the reference's largest-power-of-two split recursion
+(``merkle._split_point``); the differential suite in
+``tests/test_proofserve.py`` pins root, proofs and ``Proof.verify``
+round-trips against ``crypto/merkle.py`` bit for bit.
+
+Rails (docs/proof-serving.md):
+
+  * executables ride ``ops/aot_cache`` (tags ``sha256leaf-{lanes}x{blocks}``
+    / ``sha256layer-{lanes}``) and the warm-boot matrix
+    (``COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS``);
+  * the ``merkle_device`` breaker + host fallback make degradation
+    supervised: an infra failure can cost latency, never a wrong root or
+    proof (the fallback recomputes the WHOLE tree on the host oracle);
+  * ``set_tree_runner`` is the host-oracle seam the sim scenarios and the
+    proofserve bench drive (mirrors ``supervisor.set_device_runner``);
+  * jax-free at import time — the kernel path imports jax lazily, so a
+    /metrics scrape or a CPU-only node never initializes a backend.
+
+``COMETBFT_TPU_MERKLE_DEVICE=0`` pins the plane to the host oracle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+
+import numpy as np
+
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.proofserve import stats as pstats
+
+BREAKER = "merkle_device"
+
+# lane buckets are powers of two so every layer halves into the same
+# padded width; blocks buckets bound the scanned message length
+_MIN_LANES = 8
+_MAX_LANES_DEFAULT = 16384
+_MAX_BLOCKS = 1024  # 64 KiB leaves (part-set chunks) — bigger goes host
+_MAX_BATCH_BYTES = 1 << 25  # lanes*blocks*64 budget: cap host pack + HBM
+
+_H0 = (
+    0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+    0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,
+)
+_K = (
+    0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5,
+    0x3956C25B, 0x59F111F1, 0x923F82A4, 0xAB1C5ED5,
+    0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+    0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174,
+    0xE49B69C1, 0xEFBE4786, 0x0FC19DC6, 0x240CA1CC,
+    0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+    0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7,
+    0xC6E00BF3, 0xD5A79147, 0x06CA6351, 0x14292967,
+    0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+    0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85,
+    0xA2BFE8A1, 0xA81A664B, 0xC24B8B70, 0xC76C51A3,
+    0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+    0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5,
+    0x391C0CB3, 0x4ED8AA4A, 0x5B9CCA4F, 0x682E6FF3,
+    0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+    0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+)
+
+EMPTY_HASH = hashlib.sha256(b"").digest()
+
+
+def enabled() -> bool:
+    """COMETBFT_TPU_MERKLE_DEVICE=0 pins every tree to the host oracle."""
+    return os.environ.get("COMETBFT_TPU_MERKLE_DEVICE", "1") != "0"
+
+
+def _backend_trusted() -> bool:
+    """Same gate as ``verifysched.backend_trusted``: device tree passes
+    only when the trusted ``tpu`` batch seam is active, and NEVER
+    auto-probe (that would initialize jax from a hashing call site)."""
+    from cometbft_tpu.crypto import batch as cbatch
+
+    env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
+    if env and env != "auto":
+        return env == "tpu"
+    return cbatch._DEFAULT_BACKEND == "tpu"
+
+
+# -- host-oracle runner seam --------------------------------------------------
+
+_RUNNER_LOCK = threading.Lock()
+_TREE_RUNNER: "list" = [None]
+
+
+def set_tree_runner(fn) -> None:
+    """Install a stand-in for the device tree pass: ``fn(items) ->
+    levels`` (leaf level first, root level last).  The sim scenarios and
+    the proofserve bench pin the host oracle here so the breaker/fallback
+    machinery above the seam runs deterministically on a CPU host —
+    mirroring ``supervisor.set_device_runner``."""
+    with _RUNNER_LOCK:
+        _TREE_RUNNER[0] = fn
+
+
+def clear_tree_runner() -> None:
+    with _RUNNER_LOCK:
+        _TREE_RUNNER[0] = None
+
+
+def tree_runner():
+    with _RUNNER_LOCK:
+        return _TREE_RUNNER[0]
+
+
+def host_tree_runner(items) -> "list[list[bytes]]":
+    """The host ZIP of the tree kernel — verdict-identical by
+    construction (it IS the kernel's differential oracle)."""
+    return host_levels(items)
+
+
+def device_active() -> bool:
+    """True when tree passes should attempt the device path: an injected
+    runner always qualifies; otherwise the kill switch AND the trusted
+    batch backend gate (jax-free check)."""
+    if tree_runner() is not None:
+        return enabled()
+    return enabled() and _backend_trusted()
+
+
+# -- host oracle --------------------------------------------------------------
+
+
+def host_levels(items) -> "list[list[bytes]]":
+    """All tree levels, bottom-up: ``levels[0]`` are the RFC 6962 leaf
+    hashes, ``levels[-1]`` is ``[root]``.  Adjacent pairing with odd-tail
+    promotion — structurally equal to ``merkle.hash_from_byte_slices``'s
+    split-point recursion (pinned by the differential tests)."""
+    level = [merkle._leaf_hash(it) for it in items]
+    levels = [level]
+    while len(level) > 1:
+        nxt = [
+            merkle._inner_hash(level[j], level[j + 1])
+            for j in range(0, len(level) - 1, 2)
+        ]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+        levels.append(level)
+    return levels
+
+
+def proofs_from_levels(levels) -> "list[merkle.Proof]":
+    """Inclusion proofs assembled from precomputed levels: the aunt walk
+    is the bottom-up sibling chain, skipping the levels where the node
+    was a promoted odd tail (it has no sibling there) — byte-identical
+    to ``merkle.proofs_from_byte_slices`` (differential tests)."""
+    n = len(levels[0])
+    proofs = []
+    for i in range(n):
+        aunts = []
+        idx, cnt = i, n
+        for level in levels[:-1]:
+            if cnt == 1:
+                break
+            sib = idx ^ 1
+            if sib < cnt:
+                aunts.append(level[sib])
+            idx //= 2
+            cnt = (cnt + 1) // 2
+        proofs.append(
+            merkle.Proof(
+                total=n, index=i, leaf_hash=levels[0][i], aunts=aunts
+            )
+        )
+    return proofs
+
+
+# -- device kernels -----------------------------------------------------------
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def _compress(state, w):
+    """One SHA-256 compression, vectorized over lanes.  ``state`` is an
+    8-tuple of (B,) uint32; ``w`` a 16-list of (B,) uint32 message words.
+    uint32 arithmetic wraps in XLA exactly as the spec requires."""
+    import jax.numpy as jnp
+
+    ws = list(w)
+    for t in range(16, 64):
+        x15, x2 = ws[t - 15], ws[t - 2]
+        s0 = _rotr(x15, 7) ^ _rotr(x15, 18) ^ (x15 >> 3)
+        s1 = _rotr(x2, 17) ^ _rotr(x2, 19) ^ (x2 >> 10)
+        ws.append(ws[t - 16] + s0 + ws[t - 7] + s1)
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(_K[t]) + ws[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + s0 + maj
+    return tuple(s + v for s, v in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def _leaf_fn(words, nblocks):
+    """(blocks, B, 16) uint32 padded leaf words + (B,) int32 block counts
+    -> (B, 8) uint32 digests.  ``lax.scan`` over the block axis with
+    per-lane masking: one executable serves every leaf-length mix that
+    fits the bucket."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    lanes = words.shape[1]
+    init = tuple(jnp.full((lanes,), h, jnp.uint32) for h in _H0)
+
+    def step(carry, xs):
+        i, w = xs
+        new = _compress(carry, [w[:, j] for j in range(16)])
+        live = i < nblocks
+        return tuple(
+            jnp.where(live, n, c) for n, c in zip(new, carry)
+        ), None
+
+    state, _ = lax.scan(
+        step, init, (jnp.arange(words.shape[0], dtype=jnp.int32), words)
+    )
+    return jnp.stack(state, axis=1)
+
+
+def _layer_fn(digests, k):
+    """(B, 8) uint32 digests with valid prefix ``k`` -> (B, 8) uint32
+    parent digests with valid prefix ``ceil(k/2)``.  Adjacent pairs are
+    hashed as ``SHA-256(0x01 || left || right)`` — a fixed 65-byte
+    message assembled from digest words (2 blocks, mostly constants); an
+    odd tail is promoted unchanged via a masked select.  Output keeps the
+    input lane count, so one executable serves every level."""
+    import jax.numpy as jnp
+
+    lanes = digests.shape[0]
+    half = lanes // 2
+    left = digests[0::2]
+    right = digests[1::2]
+    c8 = jnp.uint32(0xFF)
+    w = [(jnp.uint32(0x01) << 24) | (left[:, 0] >> 8)]
+    for i in range(1, 8):
+        w.append(((left[:, i - 1] & c8) << 24) | (left[:, i] >> 8))
+    w.append(((left[:, 7] & c8) << 24) | (right[:, 0] >> 8))
+    for i in range(1, 8):
+        w.append(((right[:, i - 1] & c8) << 24) | (right[:, i] >> 8))
+    state = tuple(jnp.full((half,), h, jnp.uint32) for h in _H0)
+    state = _compress(state, w)
+    zero = jnp.zeros((half,), jnp.uint32)
+    # block 2: the dangling right-digest byte, 0x80, zeros, bitlen 520
+    w2 = [((right[:, 7] & c8) << 24) | jnp.uint32(0x80 << 16)]
+    w2 += [zero] * 14
+    w2.append(jnp.full((half,), 65 * 8, jnp.uint32))
+    state = _compress(state, w2)
+    inner = jnp.stack(state, axis=1)
+    promoted = digests[jnp.clip(k - 1, 0, lanes - 1)]
+    odd = (k % 2) == 1
+    take_tail = (jnp.arange(half) == (k // 2)) & odd
+    inner = jnp.where(take_tail[:, None], promoted[None, :], inner)
+    return jnp.concatenate(
+        [inner, jnp.zeros((lanes - half, 8), jnp.uint32)], axis=0
+    )
+
+
+_JIT_LOCK = threading.Lock()
+_JIT: dict = {}
+
+
+def _jitted(name: str):
+    with _JIT_LOCK:
+        fn = _JIT.get(name)
+        if fn is None:
+            import jax
+
+            fn = jax.jit(_leaf_fn if name == "leaf" else _layer_fn)
+            _JIT[name] = fn
+        return fn
+
+
+def leaf_tag(lanes: int, blocks: int) -> str:
+    return f"sha256leaf-{lanes}x{blocks}"
+
+
+def layer_tag(lanes: int) -> str:
+    return f"sha256layer-{lanes}"
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def max_lanes() -> int:
+    try:
+        return int(
+            os.environ.get("COMETBFT_TPU_MERKLE_MAX_LANES", "")
+            or _MAX_LANES_DEFAULT
+        )
+    except ValueError:
+        return _MAX_LANES_DEFAULT
+
+
+def _bucket_shape(items) -> "tuple[int, int] | None":
+    """(lanes, blocks) padding bucket for a leaf set, or None when the
+    set exceeds the kernel's ladder (oversize leaves / lane budget) and
+    must go to the host oracle."""
+    n = len(items)
+    cap = max_lanes()
+    if n > cap:
+        return None
+    lanes = _pow2_at_least(max(n, _MIN_LANES), _MIN_LANES)
+    need = max((len(it) + 10 + 63) // 64 for it in items)
+    if need > _MAX_BLOCKS:
+        return None
+    blocks = _pow2_at_least(need, 1)
+    if lanes * blocks * 64 > _MAX_BATCH_BYTES:
+        return None
+    return lanes, blocks
+
+
+def _pack_leaves(items, lanes: int, blocks: int):
+    """Host-side SHA-256 padding with the RFC 6962 leaf domain prefix:
+    returns (blocks, lanes, 16) uint32 big-endian words + (lanes,) int32
+    per-lane block counts."""
+    buf = np.zeros((lanes, blocks * 64), dtype=np.uint8)
+    nblk = np.zeros((lanes,), dtype=np.int32)
+    for i, it in enumerate(items):
+        m = len(it) + 1  # 0x00 domain prefix
+        total = ((m + 8) // 64 + 1) * 64
+        row = buf[i]
+        if it:
+            row[1 : m] = np.frombuffer(bytes(it), dtype=np.uint8)
+        row[m] = 0x80
+        row[total - 8 : total] = np.frombuffer(
+            struct.pack(">Q", m * 8), dtype=np.uint8
+        )
+        nblk[i] = total // 64
+    words = (
+        np.ascontiguousarray(buf)
+        .view(">u4")
+        .astype(np.uint32)
+        .reshape(lanes, blocks, 16)
+        .transpose(1, 0, 2)
+    )
+    return np.ascontiguousarray(words), nblk
+
+
+def _digest_rows(arr: np.ndarray, count: int) -> "list[bytes]":
+    raw = np.ascontiguousarray(arr[:count]).astype(">u4").tobytes()
+    return [raw[i * 32 : (i + 1) * 32] for i in range(count)]
+
+
+def device_levels(items) -> "list[list[bytes]]":
+    """The unguarded device tree pass (tests call this directly): leaf
+    kernel, then the shared layer kernel until one digest remains.
+    Raises on any infra failure — ``tree_levels`` wraps this with the
+    breaker + host fallback."""
+    runner = tree_runner()
+    if runner is not None:
+        return runner(items)
+    shape = _bucket_shape(items)
+    if shape is None:
+        raise ValueError("leaf set exceeds the device bucket ladder")
+    lanes, blocks = shape
+    from cometbft_tpu.ops import aot_cache
+
+    n = len(items)
+    words, nblk = _pack_leaves(items, lanes, blocks)
+    digs = aot_cache.cached_call(
+        _jitted("leaf"), (words, nblk), leaf_tag(lanes, blocks)
+    )
+    levels = [_digest_rows(np.asarray(digs), n)]
+    cnt = n
+    tag = layer_tag(lanes)
+    while cnt > 1:
+        digs = aot_cache.cached_call(
+            _jitted("layer"), (digs, np.int32(cnt)), tag
+        )
+        cnt = (cnt + 1) // 2
+        levels.append(_digest_rows(np.asarray(digs), cnt))
+    return levels
+
+
+def _breaker():
+    from cometbft_tpu.crypto import backend_health
+
+    return backend_health.registry().breaker(BREAKER)
+
+
+def tree_levels(items) -> "list[list[bytes]]":
+    """All tree levels for a non-empty leaf set, through the supervised
+    device→host ladder: an infra failure records a ``merkle_device``
+    breaker failure and recomputes the WHOLE tree on the host oracle, so
+    it can never produce a wrong root or proof — only a slower one."""
+    n = len(items)
+    if n == 0:
+        raise ValueError("tree_levels needs at least one leaf")
+    if device_active():
+        shape = _bucket_shape(items) if tree_runner() is None else (n, 0)
+        if shape is None:
+            pstats.record_oversize()
+        else:
+            breaker = _breaker()
+            if breaker.allow():
+                lanes = _pow2_at_least(max(n, _MIN_LANES), _MIN_LANES)
+                with tracing.span(
+                    "merkle.tree", leaves=n, lanes=lanes
+                ) as sp:
+                    try:
+                        levels = device_levels(items)
+                        breaker.record_success()
+                        pstats.record_tree(n, lanes, device=True)
+                        sp.set(path="device")
+                        return levels
+                    except Exception as e:  # noqa: BLE001 — degrade,
+                        # never serve a wrong (or no) root over infra
+                        breaker.record_failure(e)
+                        pstats.record_device_fallback()
+                        sp.set(path="fallback", error=type(e).__name__)
+                        tracing.record_anomaly(
+                            "merkle_device_fault", error=type(e).__name__
+                        )
+    levels = host_levels(items)
+    pstats.record_tree(n, 0, device=False)
+    return levels
+
+
+def tree_root(items) -> bytes:
+    """Merkle root via the plane; bit-identical to
+    ``merkle.hash_from_byte_slices`` on every input."""
+    if len(items) == 0:
+        return EMPTY_HASH
+    return tree_levels(items)[-1][0]
+
+
+def tree_proofs(items) -> "tuple[bytes, list[merkle.Proof]]":
+    """(root, proofs) via the plane; bit-identical to
+    ``merkle.proofs_from_byte_slices`` on every input."""
+    if len(items) == 0:
+        return EMPTY_HASH, []
+    levels = tree_levels(items)
+    return levels[-1][0], proofs_from_levels(levels)
+
+
+# -- warm-boot hooks ----------------------------------------------------------
+
+
+def warm_kernels(lanes: int) -> "dict[str, dict]":
+    """Resolve the leaf (1-block) + layer executables for one lanes
+    bucket without dispatching — the ``ops/warmboot`` ``sha256-tree``
+    family seam.  Returns {exec-cache tag: info}."""
+    import jax
+
+    from cometbft_tpu.ops import aot_cache
+
+    u32 = jax.ShapeDtypeStruct
+    infos = {}
+    ltag = leaf_tag(lanes, 1)
+    _, info = aot_cache.load_or_compile(
+        _jitted("leaf"),
+        (
+            u32((1, lanes, 16), np.uint32),
+            u32((lanes,), np.int32),
+        ),
+        ltag,
+    )
+    infos[ltag] = info
+    ytag = layer_tag(lanes)
+    _, info = aot_cache.load_or_compile(
+        _jitted("layer"),
+        (u32((lanes, 8), np.uint32), u32((), np.int32)),
+        ytag,
+    )
+    infos[ytag] = info
+    return infos

@@ -55,6 +55,9 @@ _DONE = threading.Event()  # a pass COMPLETED in this process
 # an EMPTY value skips that family entirely.
 DEFAULT_SECP_BUCKETS = (1, 2, 4, 8)
 DEFAULT_BLS_BUCKETS = (2, 4, 8)
+# sha256 tree kernel lane buckets (docs/proof-serving.md): 64 covers the
+# common tx-count range; bigger buckets compile on first use
+DEFAULT_MERKLE_BUCKETS = (64,)
 
 
 def enabled() -> bool:
@@ -105,6 +108,10 @@ def extra_matrix() -> "list[tuple[str, str, int]]":
         "COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", DEFAULT_BLS_BUCKETS
     ):
         shapes.append(("bls_g1", "bls-g1", b))
+    for b in _env_sizes(
+        "COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", DEFAULT_MERKLE_BUCKETS
+    ):
+        shapes.append(("merkle_device", "sha256-tree", b))
     return shapes
 
 
@@ -118,6 +125,10 @@ def _warm_extra(family: str, lanes: int) -> "dict[str, dict]":
         return {
             secp_verify.ladder_tag(lanes): secp_verify.warm_ladder(lanes)
         }
+    if family == "sha256-tree":
+        from cometbft_tpu.ops import sha256_tree
+
+        return sha256_tree.warm_kernels(lanes)
     from cometbft_tpu.ops import bls_g1
 
     return bls_g1.warm_kernels(lanes)

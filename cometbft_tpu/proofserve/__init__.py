@@ -1,0 +1,30 @@
+"""Batched Merkle/hash plane + coalesced proof serving.
+
+Three jax-free-at-import layers (docs/proof-serving.md):
+
+  * ``plane``   — the hashing front door every type-layer call site
+    uses: routes to the device tree kernel (``ops/sha256_tree``) or the
+    serial host reference, bit-identically, behind the
+    ``COMETBFT_TPU_PROOFSERVE`` kill switch and a min-batch gate;
+  * ``service`` — the proof-query coalescer (bounded queue, one tree
+    build per (kind, height) group, LRU cache) that ``rpc/core.py``
+    rides for ``tx(prove=True)`` / header / validator-hash traffic;
+  * ``stats``   — process-wide counters behind ``cometbft_merkle_*``
+    metrics and the ``trace_document()`` proofserve section.
+"""
+
+from cometbft_tpu.proofserve import plane, service, stats  # noqa: F401
+from cometbft_tpu.proofserve.plane import (  # noqa: F401
+    enabled,
+    tree_hash,
+    tree_proofs,
+)
+from cometbft_tpu.proofserve.service import (  # noqa: F401
+    ProofServer,
+    QueueFullError,
+    configure,
+    get_server,
+    prove_tx,
+    reset_server,
+    server_active,
+)

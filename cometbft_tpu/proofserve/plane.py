@@ -1,0 +1,71 @@
+"""The hashing front door: route Merkle work to the device tree kernel
+or the serial host reference, bit-identically.
+
+Every producer-side hashing call site (``types/block.py`` header/data/
+commit hashes, ``types/validator.py`` valset hash, ``state/execution.py``
+results hash, ``types/part_set.py`` part proofs) goes through
+``tree_hash``/``tree_proofs`` instead of calling ``crypto/merkle.py``
+directly — ``scripts/check_hash_callsites.py`` pins that.  The plane then
+decides the path:
+
+  * kill switch off (``COMETBFT_TPU_PROOFSERVE=0``) → the exact serial
+    reference, restoring pre-plane behavior bit for bit;
+  * tiny trees (fewer than ``COMETBFT_TPU_MERKLE_MIN_BATCH`` leaves,
+    default 32 — a 14-field header hash, a 4-validator valset) → the
+    reference as well: bucket padding + dispatch latency would dwarf the
+    14 hashes, and the reference IS the correctness oracle so there is
+    nothing to gate;
+  * everything else → ``ops/sha256_tree.tree_root``/``tree_proofs``,
+    which itself supervises device→host degradation behind the
+    ``merkle_device`` breaker.
+
+jax-free at import: the sha256_tree import happens only past the size
+gate, and that module imports jax lazily in turn.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cometbft_tpu.crypto import merkle
+
+DEFAULT_MIN_BATCH = 32
+
+
+def enabled() -> bool:
+    """Master kill switch for the whole Merkle/hash plane (the proof
+    server consults it too): ``COMETBFT_TPU_PROOFSERVE=0`` restores the
+    serial host path everywhere, bit for bit."""
+    return os.environ.get("COMETBFT_TPU_PROOFSERVE", "1") != "0"
+
+
+def min_batch() -> int:
+    try:
+        return int(
+            os.environ.get("COMETBFT_TPU_MERKLE_MIN_BATCH", "")
+            or DEFAULT_MIN_BATCH
+        )
+    except ValueError:
+        return DEFAULT_MIN_BATCH
+
+
+def tree_hash(items) -> bytes:
+    """Merkle root of ``items`` — bit-identical to
+    ``merkle.hash_from_byte_slices`` on every path."""
+    items = list(items)
+    if not enabled() or len(items) < min_batch():
+        return merkle.hash_from_byte_slices(items)
+    from cometbft_tpu.ops import sha256_tree
+
+    return sha256_tree.tree_root(items)
+
+
+def tree_proofs(items):
+    """(root, [Proof]) for ``items`` — bit-identical to
+    ``merkle.proofs_from_byte_slices`` on every path."""
+    items = list(items)
+    if not enabled() or len(items) < min_batch():
+        return merkle.proofs_from_byte_slices(items)
+    from cometbft_tpu.ops import sha256_tree
+
+    return sha256_tree.tree_proofs(items)

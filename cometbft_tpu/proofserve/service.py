@@ -1,0 +1,431 @@
+"""Coalescing proof server for light-client read traffic.
+
+``rpc/core.py`` historically answered every ``tx(prove=True)``, header
+and validator-hash query with its own serial Merkle walk — at 10k
+concurrent light clients that is 10k redundant tree builds per block.
+This module is the read-side sibling of ``verifysched/service.py`` (the
+same continuous-batching shape, pointed at hashing instead of
+signatures):
+
+  * RPC handlers ``submit(kind, height)`` into a bounded queue and get a
+    Future;
+  * one dispatcher thread coalesces pending queries ACROSS all clients
+    into (kind, height) groups and builds each group's tree ONCE through
+    ``proofserve/plane.py`` (device kernel when trusted, host reference
+    otherwise — bit-identical either way), flushing when the oldest
+    query has waited ``COMETBFT_TPU_PROOFSERVE_FLUSH_US`` (~1000) or the
+    queue fills;
+  * an LRU cache keyed (kind, height) answers repeat queries for recent
+    blocks without a queue slot — the steady-state stampede path is a
+    lock + dict hit;
+  * sheds (``QueueFullError``) and future timeouts fall back to the
+    caller's serial build (``prove_tx``): a shed query costs the
+    coalescing win, never a lost response, and NOTHING consensus-class
+    ever rides this queue — proof serving is read-only traffic, so
+    overload here cannot shed a vote by construction.
+
+The server is decoupled from block/state types via three loaders
+injected at ``configure`` time (``node/node.py`` wires them at start):
+``tx_loader(h) -> list[tx bytes] | None``, ``header_hasher(h) -> bytes |
+None``, ``valset_hasher(h) -> bytes | None``.  Kill switch
+``COMETBFT_TPU_PROOFSERVE=0`` (shared with the plane) restores today's
+serial RPC path bit for bit.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Callable, Optional
+
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.proofserve import plane
+from cometbft_tpu.proofserve import stats as pstats
+
+logger = logging.getLogger("cometbft_tpu.proofserve")
+
+DEFAULT_FLUSH_US = 1000.0
+DEFAULT_QUEUE_CAP = 4096
+DEFAULT_CACHE_CAP = 128
+
+KINDS = pstats.KINDS
+
+
+class QueueFullError(Exception):
+    """Admission control rejected a proof query (backpressure).  The
+    caller builds serially instead — shedding costs the coalescing win,
+    never the response."""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _Query:
+    __slots__ = ("kind", "height", "future", "t0")
+
+    def __init__(self, kind, height, future, t0):
+        self.kind = kind
+        self.height = height
+        self.future = future
+        self.t0 = t0
+
+
+class ProofServer:
+    """One dispatcher thread over one bounded queue of (kind, height)
+    proof queries.  Thread-safe; lazily starts (and restarts) its thread
+    on the first queued submission and drains everything (reason
+    ``shutdown``) on ``close()`` — a future handed out is always
+    eventually resolved.
+
+    Resolution types: ``tx`` → ``(root, [Proof])`` for the whole block
+    (the caller indexes its tx — that sharing is the coalescing win) or
+    ``None`` when the height is missing; ``header``/``valset`` →
+    ``bytes`` or ``None``."""
+
+    def __init__(
+        self,
+        tx_loader: Callable[[int], Optional[list]],
+        header_hasher: Callable[[int], Optional[bytes]],
+        valset_hasher: Callable[[int], Optional[bytes]],
+        flush_us: Optional[float] = None,
+        queue_cap: Optional[int] = None,
+        cache_cap: Optional[int] = None,
+    ):
+        self._loaders = {
+            "tx": tx_loader,
+            "header": header_hasher,
+            "valset": valset_hasher,
+        }
+        if flush_us is None:
+            flush_us = _env_float(
+                "COMETBFT_TPU_PROOFSERVE_FLUSH_US", DEFAULT_FLUSH_US
+            )
+        if queue_cap is None:
+            queue_cap = _env_int(
+                "COMETBFT_TPU_PROOFSERVE_QUEUE", DEFAULT_QUEUE_CAP
+            )
+        if cache_cap is None:
+            cache_cap = _env_int(
+                "COMETBFT_TPU_PROOFSERVE_CACHE", DEFAULT_CACHE_CAP
+            )
+        self.flush_s = max(float(flush_us), 0.0) / 1e6
+        self.queue_cap = max(int(queue_cap), 1)
+        self.cache_cap = max(int(cache_cap), 1)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: "deque[_Query]" = deque()
+        self._cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._paused = False
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, kind: str, height: int) -> "Future":
+        """Queue one proof query; returns a Future.  An LRU hit resolves
+        immediately without occupying a queue slot.  Raises
+        ``QueueFullError`` at capacity — proof traffic is all
+        read-class, so unlike the verify scheduler there is no
+        shed-exempt tier."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown proof kind {kind!r}")
+        height = int(height)
+        fut: "Future" = Future()
+        try:
+            with self._cond:
+                if self._stopped:
+                    raise RuntimeError("proof server is stopped")
+                key = (kind, height)
+                if key in self._cache:
+                    self._cache.move_to_end(key)
+                    val = self._cache[key]
+                    pstats.record_cache_hit(kind)
+                    fut.set_result(val)
+                    return fut
+                if len(self._queue) >= self.queue_cap:
+                    pstats.record_shed(kind)
+                    raise QueueFullError(
+                        f"proof queue at capacity ({self.queue_cap}); "
+                        f"shedding {kind} query"
+                    )
+                self._queue.append(
+                    _Query(kind, height, fut, time.perf_counter())
+                )
+                pstats.record_query(kind)
+                if self._thread is None or not self._thread.is_alive():
+                    if self._thread is not None:
+                        logger.error(
+                            "proof dispatcher thread died; restarting "
+                            "(%d queries pending)",
+                            len(self._queue),
+                        )
+                    self._thread = threading.Thread(
+                        target=self._run, name="proof-serve", daemon=True
+                    )
+                    self._thread.start()
+                self._cond.notify_all()
+        except QueueFullError:
+            # anomaly recorded AFTER the cond is released: the flight
+            # recorder's ring-dump IO must never block other submitters
+            tracing.record_anomaly(
+                "proof_shed", query_kind=kind, queue_cap=self.queue_cap
+            )
+            raise
+        return fut
+
+    # -- test/bench hooks -------------------------------------------------
+
+    def pause(self) -> None:
+        """Hold flushing (test/sim hook: build a deterministic backlog
+        so a whole stampede coalesces into one flush)."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def cached(self, kind: str, height: int) -> bool:
+        with self._lock:
+            return (kind, int(height)) in self._cache
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting work, drain the queue (reason ``shutdown``)
+        and join the dispatcher.  Every outstanding future resolves."""
+        with self._cond:
+            self._stopped = True
+            self._paused = False
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            if t.is_alive():
+                logger.warning(
+                    "proof dispatcher still alive %.1fs after close()",
+                    timeout_s,
+                )
+
+    # -- dispatcher -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                    not self._queue or self._paused
+                ):
+                    self._cond.wait()
+                if self._stopped and not self._queue:
+                    return
+                reason = "shutdown"
+                if not self._stopped:
+                    while True:
+                        if self._stopped or self._paused:
+                            break
+                        if len(self._queue) >= self.queue_cap:
+                            reason = "full"
+                            break
+                        if not self._queue:
+                            break
+                        remain = (
+                            self._queue[0].t0
+                            + self.flush_s
+                            - time.perf_counter()
+                        )
+                        if remain <= 0:
+                            reason = "deadline"
+                            break
+                        self._cond.wait(remain)
+                    if self._paused and not self._stopped:
+                        continue
+                    if not self._queue:
+                        continue
+                items = list(self._queue)
+                self._queue.clear()
+            if items:
+                self._execute(items, reason)
+
+    # -- flush ------------------------------------------------------------
+
+    def _build(self, kind: str, height: int):
+        """One uncached build through the plane.  ``tx`` builds the
+        whole block's proof set in one tree pass; ``header``/``valset``
+        delegate to hashers whose own tree work already routes through
+        the plane at the type layer."""
+        if kind == "tx":
+            txs = self._loaders["tx"](height)
+            if txs is None:
+                return None
+            return plane.tree_proofs([bytes(t) for t in txs])
+        return self._loaders[kind](height)
+
+    def _execute(self, items: "list[_Query]", reason: str) -> None:
+        recorded = [False]
+        try:
+            self._execute_inner(items, reason, recorded)
+        except BaseException as e:  # noqa: BLE001 — futures must ALWAYS
+            # resolve: these queries left the queue, so the submit-path
+            # thread restart can never recover them
+            logger.exception(
+                "proof flush failed unexpectedly; failing %d queries",
+                len(items),
+            )
+            if not recorded[0]:
+                pstats.record_flush(reason, len(items), 0)
+            for it in items:
+                if not it.future.done():
+                    it.future.set_exception(
+                        e if isinstance(e, Exception) else RuntimeError(
+                            type(e).__name__
+                        )
+                    )
+            if not isinstance(e, Exception):
+                raise
+
+    def _execute_inner(
+        self, items: "list[_Query]", reason: str, recorded: "list[bool]"
+    ) -> None:
+        groups: "OrderedDict[tuple, list[_Query]]" = OrderedDict()
+        for it in items:
+            groups.setdefault((it.kind, it.height), []).append(it)
+        resolutions: "list[tuple[Future, object, Optional[Exception]]]" = []
+        builds = 0
+        with tracing.span(
+            "proof.flush",
+            reason=reason,
+            queries=len(items),
+            groups=len(groups),
+        ) as sp:
+            for (kind, height), members in groups.items():
+                with self._lock:
+                    hit = (kind, height) in self._cache
+                    val = self._cache.get((kind, height))
+                if not hit:
+                    pstats.record_cache_miss()
+                    try:
+                        val = self._build(kind, height)
+                    except Exception as e:  # noqa: BLE001 — fail the
+                        # group, keep flushing the rest
+                        for m in members:
+                            resolutions.append((m.future, None, e))
+                        continue
+                    pstats.record_build(kind)
+                    builds += 1
+                    if val is not None:
+                        with self._lock:
+                            self._cache[(kind, height)] = val
+                            self._cache.move_to_end((kind, height))
+                            while len(self._cache) > self.cache_cap:
+                                self._cache.popitem(last=False)
+                for m in members:
+                    resolutions.append((m.future, val, None))
+            sp.set(builds=builds)
+        # record BEFORE resolving (same discipline as verifysched): a
+        # waiter reading stats right after its result must not race the
+        # dispatcher's bookkeeping
+        pstats.record_flush(reason, len(items), len(groups))
+        recorded[0] = True
+        for fut, val, exc in resolutions:
+            if fut.done():
+                continue
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(val)
+
+
+# -- process-wide instance ----------------------------------------------------
+
+_SERVER: Optional[ProofServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def configure(
+    tx_loader, header_hasher, valset_hasher, **kwargs
+) -> ProofServer:
+    """Install the process-wide proof server (``node/node.py`` calls
+    this at start with store-backed loaders).  Replaces — and drains —
+    any previous instance."""
+    global _SERVER
+    server = ProofServer(tx_loader, header_hasher, valset_hasher, **kwargs)
+    with _SERVER_LOCK:
+        prev, _SERVER = _SERVER, server
+    if prev is not None:
+        prev.close()
+    return server
+
+
+def get_server() -> Optional[ProofServer]:
+    with _SERVER_LOCK:
+        return _SERVER
+
+
+def reset_server() -> None:
+    """Drain + drop the process-wide server (node stop / tests / sim)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        server, _SERVER = _SERVER, None
+    if server is not None:
+        server.close()
+
+
+def server_active() -> bool:
+    """True when RPC proof queries should ride the coalescer: kill
+    switch on AND a server configured."""
+    return plane.enabled() and get_server() is not None
+
+
+def prove_tx(
+    tx_loader, height: int, index: int, timeout_s: float = 5.0
+):
+    """(root, Proof) for tx ``index`` of block ``height`` — THE wrapper
+    ``rpc/core.py`` calls.  Coalesced through the server when active;
+    a shed, a timeout, or no server at all degrades to the caller's
+    serial build (``merkle.proofs_from_byte_slices``), so the response
+    is never lost and the kill-switch path is exactly today's serial
+    code.  Returns None when the height/index doesn't exist."""
+    if server_active():
+        try:
+            res = get_server().submit("tx", height).result(timeout_s)
+            if res is None:
+                return None
+            root, proofs = res
+            if 0 <= index < len(proofs):
+                return root, proofs[index]
+            return None
+        except QueueFullError:
+            pstats.record_serial_fallback()
+        except FutureTimeoutError:
+            pstats.record_serial_fallback()
+        except RuntimeError:
+            # server torn down under us (stop race): serial fallback
+            pstats.record_serial_fallback()
+    txs = tx_loader(height)
+    if txs is None:
+        return None
+    txs = [bytes(t) for t in txs]
+    if not 0 <= index < len(txs):
+        return None
+    root, proofs = merkle.proofs_from_byte_slices(txs)
+    return root, proofs[index]

@@ -637,6 +637,38 @@ class Environment:
 
     # -- tx lookup (via indexer when present) ------------------------------
 
+    def _tx_loader(self, height: int):
+        """Block-store tx loader for the proof plane (None = unknown
+        height — pruned or not yet committed)."""
+        blk = self.node.block_store.load_block(int(height))
+        if blk is None:
+            return None
+        return list(blk.data.txs)
+
+    def _tx_proof_json(self, result) -> Optional[dict]:
+        """ResultTx.Proof JSON (reference: rpc/core/tx.go Tx +
+        types.TxProof): the inclusion proof of ``result.tx`` against the
+        committed block's ``data_hash``.  Coalesced through the proof
+        server when active; serial otherwise — byte-identical."""
+        from cometbft_tpu import proofserve
+
+        got = proofserve.prove_tx(
+            self._tx_loader, result.height, result.index
+        )
+        if got is None:
+            return None
+        root, proof = got
+        return {
+            "root_hash": _hex(root),
+            "data": _b64(result.tx),
+            "proof": {
+                "total": str(proof.total),
+                "index": str(proof.index),
+                "leaf_hash": _b64(proof.leaf_hash),
+                "aunts": [_b64(a) for a in proof.aunts],
+            },
+        }
+
     def tx(self, hash_: str, prove: bool = False) -> dict:
         indexer = getattr(self.node, "tx_indexer", None)
         if indexer is None:
@@ -645,7 +677,10 @@ class Environment:
         result = indexer.get(raw_hash)
         if result is None:
             raise RPCError(-32603, f"tx {hash_} not found")
-        return result.to_json()
+        doc = result.to_json()
+        if prove:
+            doc["proof"] = self._tx_proof_json(result)
+        return doc
 
     def tx_search(
         self,
@@ -664,8 +699,14 @@ class Environment:
         per_page = max(1, min(per_page, 100))
         start = (max(page, 1) - 1) * per_page
         window = results[start : start + per_page]
+        txs = []
+        for r in window:
+            doc = r.to_json()
+            if prove:
+                doc["proof"] = self._tx_proof_json(r)
+            txs.append(doc)
         return {
-            "txs": [r.to_json() for r in window],
+            "txs": txs,
             "total_count": str(len(results)),
         }
 

@@ -144,6 +144,9 @@ class ScenarioResult:
     # run saw injector or real-IO trouble — plus the fail-stopped nodes
     storage: dict = field(default_factory=dict)
     fail_stopped: list = field(default_factory=list)
+    # Merkle/hash-plane + proof-server counters captured at end-of-run
+    # (light-stampede): queries/cache hits per kind, sheds, tree builds…
+    proofs: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -210,6 +213,21 @@ class ScenarioResult:
                 row["storage"]["fail_stopped_nodes"] = list(
                     self.fail_stopped
                 )
+        if self.proofs:
+            row["proofs"] = {
+                k: self.proofs[k]
+                for k in (
+                    "queries_total",
+                    "cache_hits_total",
+                    "shed_total",
+                    "serial_fallbacks",
+                    "tree_builds_total",
+                    "trees_device",
+                    "trees_host",
+                    "proof_cache_hit_rate",
+                    "queries_per_flush",
+                )
+            }
         if self.spans:
             row["spans"] = {
                 "recorded": self.spans.get("recorded", 0),
@@ -319,6 +337,15 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_TXINGEST_QUEUE",
     "COMETBFT_TPU_TXINGEST_BATCH",
     "COMETBFT_TPU_TXINGEST_FLUSH_US",
+    # Merkle/hash plane + proof server (proofserve): light-stampede
+    # overrides these via extra_env; same save/restore as the rest
+    "COMETBFT_TPU_PROOFSERVE",
+    "COMETBFT_TPU_PROOFSERVE_QUEUE",
+    "COMETBFT_TPU_PROOFSERVE_FLUSH_US",
+    "COMETBFT_TPU_PROOFSERVE_CACHE",
+    "COMETBFT_TPU_MERKLE_MIN_BATCH",
+    "COMETBFT_TPU_MERKLE_DEVICE",
+    "COMETBFT_TPU_MERKLE_MAX_LANES",
     # elastic mesh supervision (parallel/elastic): mesh scenarios force
     # membership + the shard runner in setup; these knobs ride the same
     # save/restore as everything else
@@ -841,6 +868,135 @@ def _gossip_burst(s: Scenario) -> list[Action]:
         Action(float(t), "bulk verify burst (256 items)", burst)
         for t in (3, 5, 7)
     ]
+
+
+def _light_stampede(s: Scenario) -> list[Action]:
+    """Light-client read stampede against the proof-serving coalescer
+    (docs/proof-serving.md): scripted bursts of tx/header/valset proof
+    queries — thousands per burst against a scenario-shrunk queue — fire
+    mid-consensus on node0's stores, on the host-oracle tree-runner seam.
+    Admission control sheds only proof queries (nothing consensus-class
+    rides this queue by construction); consensus agreement and progress
+    must be untouched, every admitted future must resolve, and the
+    response-bytes digest logged into the byte-compared trace makes the
+    answers themselves part of the determinism check."""
+
+    def stampede(c: SimCluster) -> None:
+        import hashlib
+
+        from cometbft_tpu import proofserve
+
+        node = c.nodes[0]
+        if node is None:
+            return
+        bs, ss = node.block_store, node.state_store
+
+        def tx_loader(h):
+            blk = bs.load_block(int(h))
+            return None if blk is None else list(blk.data.txs)
+
+        def header_hasher(h):
+            meta = bs.load_block_meta(int(h))
+            return None if meta is None else meta.header.hash()
+
+        def valset_hasher(h):
+            try:
+                vals = ss.load_validators(int(h))
+            except Exception:  # noqa: BLE001 — pruned/unknown height
+                return None
+            return None if vals is None else vals.hash()
+
+        srv = proofserve.get_server()
+        if srv is None:
+            srv = proofserve.configure(tx_loader, header_hasher, valset_hasher)
+        top = max(bs.height(), 1)
+        shed = 0
+        futs = []
+        # pause/resume brackets the burst so the overload is
+        # deterministic: the sim is single-threaded, so the dispatcher
+        # cannot drain mid-burst and exactly queue_cap non-cache-hit
+        # queries are admitted (LRU hits resolve without a slot)
+        srv.pause()
+        try:
+            for i in range(1500):
+                kind = ("header", "valset", "tx")[i % 3]
+                h = max(1, top - (i % 2))
+                try:
+                    futs.append((kind, srv.submit(kind, h)))
+                except proofserve.QueueFullError:
+                    shed += 1
+        finally:
+            srv.resume()
+        # wait every admitted future out (queue empty again before the
+        # action returns — the next burst's shed count cannot depend on
+        # dispatcher wall-time), folding the response bytes into a
+        # digest: the ANSWERS are part of the byte-compared trace
+        digest = hashlib.sha256()
+        for kind, f in futs:
+            res = f.result(timeout=30)
+            if res is None:
+                digest.update(b"\x00none")
+            elif kind == "tx":
+                root, proofs = res
+                digest.update(root)
+                for p in proofs:
+                    digest.update(p.leaf_hash)
+                    for a in p.aunts:
+                        digest.update(a)
+            else:
+                digest.update(res)
+        c._log(
+            "scenario: proof stampede of 1500 queries at h=%d, %d shed, "
+            "digest=%s" % (top, shed, digest.hexdigest()[:16])
+        )
+
+    return [
+        Action(float(t), "light-client proof stampede (1500 queries)", stampede)
+        for t in (3, 5, 7)
+    ]
+
+
+def _light_stampede_setup():
+    base = _backend_faults_setup(
+        {
+            # verify scheduler ON so the run proves proof traffic cannot
+            # shed consensus-class verifies (they ride different queues)
+            "COMETBFT_TPU_VERIFY_SCHED": "1",
+            "COMETBFT_TPU_PROOFSERVE": "1",
+            "COMETBFT_TPU_PROOFSERVE_QUEUE": "512",
+            "COMETBFT_TPU_PROOFSERVE_FLUSH_US": "500",
+            # sim blocks are small: drop the min-batch gate so tree
+            # passes actually traverse the plane's device path (the
+            # host-oracle runner below keeps it off real XLA)
+            "COMETBFT_TPU_MERKLE_MIN_BATCH": "4",
+        }
+    )
+
+    def setup(cluster: SimCluster) -> None:
+        base(cluster)
+        from cometbft_tpu import proofserve
+        from cometbft_tpu.ops import sha256_tree
+
+        # host-oracle tree-runner seam: the breaker/stats machinery above
+        # the seam runs unchanged, with no real XLA dispatch (mirrors
+        # _sim_device_runner); cleared in teardown
+        sha256_tree.set_tree_runner(sha256_tree.host_tree_runner)
+        proofserve.reset_server()
+        proofserve.stats.reset()
+
+    return setup
+
+
+def _light_stampede_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu import proofserve
+    from cometbft_tpu.ops import sha256_tree
+
+    # drain the proof server BEFORE the env knobs flip back (its
+    # dispatcher must finish under the scenario's tree runner)
+    proofserve.reset_server()
+    proofserve.stats.reset()
+    sha256_tree.clear_tree_runner()
+    _backend_faults_teardown(cluster)
 
 
 def _txflood_app():
@@ -1564,6 +1720,23 @@ SCENARIOS: dict[str, Scenario] = {
             teardown=_backend_faults_teardown,
         ),
         Scenario(
+            "light-stampede",
+            "light-client read stampede: scripted 1500-query proof "
+            "bursts (tx/header/valset mixes) against a 512-slot proof "
+            "queue mid-consensus, on the host-oracle tree-runner seam: "
+            "coalescing must collapse each burst into a handful of tree "
+            "builds, shed only proof queries (consensus-class verify "
+            "shed stays 0 by construction), answer every admitted "
+            "future, and keep the response digest byte-identical per "
+            "seed.  Runs on the host-oracle seam so tier-1 never pays "
+            "real XLA dispatches",
+            target_height=6,
+            max_time=180.0,
+            actions=_light_stampede,
+            setup=_light_stampede_setup(),
+            teardown=_light_stampede_teardown,
+        ),
+        Scenario(
             "tx-flood",
             "sustained scripted signed-tx bursts (valid/forged/malformed/"
             "oversize/duplicate mixes) from every peer against a 32-slot "
@@ -1905,6 +2078,12 @@ def run_scenario(
 
     _sstats.reset()
     _istats.reset()
+    # proof-plane counters are per-run too: every scenario's commits hash
+    # through the plane, and a soak row must reflect ITS run alone
+    from cometbft_tpu.proofserve import stats as _pstats
+
+    _pstats.reset()
+    proofs_counters: dict = {}
     # disk-fault counters are per-run too: every scenario writes WALs
     # through the guard, and a soak row must reflect ITS run's IO alone
     from cometbft_tpu.libs import storage_stats as _ss
@@ -1959,6 +2138,13 @@ def run_scenario(
             isnap = istats.snapshot()
             if isnap["enqueued"] or isnap["shed_to_sync"] or isnap["flushes"]:
                 ingest_counters = isnap
+        # proof-plane counters (light-stampede): only when the proof
+        # server / tree plane actually saw traffic this run
+        psnap = _pstats.snapshot()
+        if psnap["queries_total"] or psnap["trees_device"] or psnap[
+            "trees_host"
+        ]:
+            proofs_counters = psnap
         # evidence-pool counters (dup-vote-flood / light-attack): only
         # when the pool actually saw traffic this run
         from cometbft_tpu.evidence import stats as evstats
@@ -2046,4 +2232,5 @@ def run_scenario(
         postmortems=postmortem_capture,
         storage=storage_capture,
         fail_stopped=fail_stopped_capture,
+        proofs=proofs_counters,
     )

@@ -15,7 +15,6 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from cometbft_tpu.abci import types as at
-from cometbft_tpu.crypto import merkle
 from cometbft_tpu.libs import protoenc as pe
 from cometbft_tpu.state.state import State, _params_from_json, _params_to_json
 from cometbft_tpu.state.store import StateStore
@@ -64,7 +63,9 @@ def exec_tx_result_encode(r: at.ExecTxResult) -> bytes:
 
 
 def results_hash(results: Sequence[at.ExecTxResult]) -> bytes:
-    return merkle.hash_from_byte_slices(
+    from cometbft_tpu.proofserve import plane
+
+    return plane.tree_hash(
         [exec_tx_result_encode(r) for r in results]
     )
 

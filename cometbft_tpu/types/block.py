@@ -11,8 +11,9 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
-from cometbft_tpu.crypto import merkle, tmhash
+from cometbft_tpu.crypto import tmhash
 from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.proofserve import plane
 from cometbft_tpu.types.basic import (
     BLOCK_ID_FLAG_ABSENT,
     BLOCK_ID_FLAG_COMMIT,
@@ -75,7 +76,7 @@ class Header:
             self.evidence_hash,
             self.proposer_address,
         ]
-        return merkle.hash_from_byte_slices(fields)
+        return plane.tree_hash(fields)
 
     def validate_basic(self) -> str | None:
         if not self.chain_id or len(self.chain_id) > 50:
@@ -92,7 +93,7 @@ class Data:
     txs: list[bytes] = field(default_factory=list)
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(list(self.txs))
+        return plane.tree_hash(list(self.txs))
 
 
 @dataclass
@@ -201,7 +202,7 @@ class Commit:
                 + pe.t_message(3, cs.timestamp.encode())
                 + pe.t_bytes(4, cs.signature)
             )
-        return merkle.hash_from_byte_slices(items)
+        return plane.tree_hash(items)
 
     def validate_basic(self) -> str | None:
         if self.height < 0:
@@ -281,7 +282,7 @@ class Block:
         if not self.header.data_hash:
             self.header.data_hash = self.data.hash()
         if not self.header.evidence_hash:
-            self.header.evidence_hash = merkle.hash_from_byte_slices(
+            self.header.evidence_hash = plane.tree_hash(
                 [ev.hash() for ev in self.evidence]
             )
 
@@ -314,7 +315,7 @@ class Block:
             return "last commit hash mismatch"
         if self.header.data_hash != self.data.hash():
             return "data hash mismatch"
-        if self.header.evidence_hash != merkle.hash_from_byte_slices(
+        if self.header.evidence_hash != plane.tree_hash(
             [ev.hash() for ev in self.evidence]
         ):
             return "evidence hash mismatch"

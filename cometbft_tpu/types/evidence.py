@@ -191,9 +191,9 @@ Evidence = object  # duck-typed: DuplicateVoteEvidence | LightClientAttackEviden
 
 
 def evidence_list_hash(evidence: list) -> bytes:
-    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.proofserve import plane
 
-    return merkle.hash_from_byte_slices([ev.hash() for ev in evidence])
+    return plane.tree_hash([ev.hash() for ev in evidence])
 
 
 def evidence_list_bytes(evidence: list) -> int:

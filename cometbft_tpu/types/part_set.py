@@ -45,7 +45,9 @@ class PartSet:
         chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)]
         if not chunks:
             chunks = [b""]
-        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        from cometbft_tpu.proofserve import plane
+
+        root, proofs = plane.tree_proofs(chunks)
         ps = PartSet(PartSetHeader(total=len(chunks), hash=root))
         for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
             ps.parts[i] = Part(index=i, bytes_=chunk, proof=proof)

@@ -14,7 +14,6 @@ from __future__ import annotations
 from dataclasses import dataclass, replace
 from typing import Iterable, Optional
 
-from cometbft_tpu.crypto import merkle
 from cometbft_tpu.libs import protoenc as pe
 
 MAX_INT64 = (1 << 63) - 1
@@ -263,6 +262,8 @@ class ValidatorSet:
     def hash(self) -> bytes:
         """Merkle root of SimpleValidator encodings in set order
         (reference: types/validator_set.go Hash)."""
-        return merkle.hash_from_byte_slices(
+        from cometbft_tpu.proofserve import plane
+
+        return plane.tree_hash(
             [v.simple_encode() for v in self.validators]
         )

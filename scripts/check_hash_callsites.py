@@ -1,0 +1,121 @@
+"""CI lint: keep future code on the batched Merkle/hash plane.
+
+The proof plane (``cometbft_tpu/proofserve/``, docs/proof-serving.md)
+only batches tree hashing onto the device — and only coalesces
+light-client proof traffic — if callers go through it.  A new subsystem
+that calls ``crypto.merkle.hash_from_byte_slices`` /
+``proofs_from_byte_slices`` directly silently opts out of the device
+kernel, the breaker supervision, and the proof cache.  This gate fails
+on any DIRECT call site of those functions in production code
+(``cometbft_tpu/``) outside:
+
+  * ``cometbft_tpu/crypto/``     — merkle itself plus the host oracle
+    every differential test compares against;
+  * ``cometbft_tpu/proofserve/`` — the plane (its below-min-batch and
+    kill-switch fallbacks ARE the sanctioned serial path);
+  * ``cometbft_tpu/ops/``        — the device kernel layer
+    (sha256_tree's host oracle / fallback recompute);
+
+plus a PINNED allowlist of legacy sites (each justified inline).
+Growing a legacy file's call-site count — or adding one anywhere else —
+is a failure: new code calls ``proofserve.plane.tree_hash`` /
+``tree_proofs`` instead, which fall back to merkle bit-for-bit below
+the min batch or when the plane is disabled.
+
+Usage (wired into tier-1 next to check_verify_callsites.py):
+    python scripts/check_hash_callsites.py [--repo-root PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import sys
+
+_SEAM_NAMES = frozenset(
+    ("hash_from_byte_slices", "proofs_from_byte_slices")
+)
+
+ALLOWED_DIRS = (
+    "cometbft_tpu/crypto",
+    "cometbft_tpu/proofserve",
+    "cometbft_tpu/ops",
+)
+ALLOWED_FILES = ()
+
+# Legacy direct call sites pinned at their current counts.  Empty today:
+# every production tree-hash (header/data/commit/evidence/valset/results/
+# part-set) already routes through the plane.  Anything that appears here
+# later must carry an inline justification.
+LEGACY_MAX: "dict[str, int]" = {}
+
+
+def _call_sites(source: str) -> "list[tuple[int, str]]":
+    """(lineno, call text) for every AST Call whose callee name is one of
+    the seam functions — comments, docstrings and string literals can
+    mention the names freely without tripping the gate."""
+    hits = []
+    for node in ast.walk(ast.parse(source)):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.id
+            if isinstance(fn, ast.Name)
+            else fn.attr
+            if isinstance(fn, ast.Attribute)
+            else None
+        )
+        if name in _SEAM_NAMES:
+            hits.append((node.lineno, f"{name}(...)"))
+    return sorted(hits)
+
+
+def scan(repo_root: pathlib.Path) -> "list[str]":
+    """Return violation messages (empty = clean)."""
+    violations = []
+    pkg = repo_root / "cometbft_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(repo_root).as_posix()
+        if any(
+            rel == d or rel.startswith(d + "/") for d in ALLOWED_DIRS
+        ) or rel in ALLOWED_FILES:
+            continue
+        try:
+            hits = _call_sites(path.read_text(errors="replace"))
+        except SyntaxError as e:
+            violations.append(f"{rel}: unparsable ({e}) — cannot lint")
+            continue
+        cap = LEGACY_MAX.get(rel, 0)
+        if len(hits) > cap:
+            for lineno, line in hits:
+                violations.append(f"{rel}:{lineno}: {line}")
+            violations.append(
+                f"{rel}: {len(hits)} direct merkle call site(s), "
+                f"allowed {cap} — route new work through "
+                "cometbft_tpu/proofserve (see docs/proof-serving.md)"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo-root",
+        default=str(pathlib.Path(__file__).resolve().parent.parent),
+        help="repository root (default: this script's parent's parent)",
+    )
+    args = ap.parse_args(argv)
+    violations = scan(pathlib.Path(args.repo_root))
+    if violations:
+        print("hash-callsites: FAIL", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("hash-callsites: OK (all callers on the proof plane)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -77,6 +77,19 @@ def _row_extra(row: dict) -> str:
             ingest.get("rejected_total", 0),
             ingest.get("batch_occupancy", 0.0),
         )
+    proofs = row.get("proofs") or {}
+    if proofs:
+        # light-stampede: read-plane discipline at a glance — admitted
+        # queries, cache hit rate, shed volume, coalesced tree builds,
+        # device vs host trees
+        extra += " proofs[q=%d hit=%.2f shed=%d build=%d dev=%d/%d]" % (
+            proofs.get("queries_total", 0),
+            proofs.get("proof_cache_hit_rate", 0.0),
+            proofs.get("shed_total", 0),
+            proofs.get("tree_builds_total", 0),
+            proofs.get("trees_device", 0),
+            proofs.get("trees_device", 0) + proofs.get("trees_host", 0),
+        )
     evidence = row.get("evidence") or {}
     if evidence:
         # evidence scenarios: pool discipline under flood

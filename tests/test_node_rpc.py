@@ -237,3 +237,56 @@ def test_tx_indexing_and_search(node):
     # block search by height range
     bs = _rpc(port, "block_search", {"query": f"block.height<={height}"})
     assert int(bs["total_count"]) >= 1
+
+
+def test_tx_prove_roundtrip(node):
+    """tx(prove=True) returns an inclusion proof that verifies against
+    the committed block's data_hash (reference: rpc/core/tx.go Tx +
+    types.TxProof.Validate) — end-to-end through the proof plane."""
+    from cometbft_tpu.crypto import merkle
+
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    tx_b = b"provekey=proveval"
+    res = _rpc(port, "broadcast_tx_commit", {"tx": base64.b64encode(tx_b).decode()})
+    assert res["tx_result"]["code"] == 0
+    height = res["height"]
+
+    import hashlib
+
+    tx_hash = hashlib.sha256(tx_b).hexdigest().upper()
+    deadline = time.monotonic() + 10
+    got = None
+    while time.monotonic() < deadline:
+        try:
+            got = _rpc(port, "tx", {"hash": tx_hash, "prove": True})
+            break
+        except RuntimeError:
+            time.sleep(0.1)
+    assert got is not None, "tx never indexed"
+    pj = got["proof"]
+    assert pj is not None, "prove=True returned no proof"
+    assert base64.b64decode(pj["data"]) == tx_b
+
+    # the proof's root IS the committed header's data_hash
+    blk = _rpc(port, "block", {"height": height})
+    assert pj["root_hash"] == blk["block"]["header"]["data_hash"]
+
+    # and the proof verifies that root covers this tx
+    proof = merkle.Proof(
+        total=int(pj["proof"]["total"]),
+        index=int(pj["proof"]["index"]),
+        leaf_hash=base64.b64decode(pj["proof"]["leaf_hash"]),
+        aunts=[base64.b64decode(a) for a in pj["proof"]["aunts"]],
+    )
+    root = bytes.fromhex(pj["root_hash"])
+    assert proof.verify(root, tx_b)
+
+    # tx_search carries the same proof shape
+    ts = _rpc(
+        port,
+        "tx_search",
+        {"query": "app.key='provekey'", "prove": True},
+    )
+    assert int(ts["total_count"]) == 1
+    assert ts["txs"][0]["proof"]["root_hash"] == pj["root_hash"]

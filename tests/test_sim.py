@@ -471,6 +471,63 @@ class TestBackendFaultScenarios:
         assert all(len(v) == 1 for v in per_burst.values()), per_burst
         assert self._snapshot_globals() == before
 
+    def test_light_stampede_proof_plane(self, tmp_path, monkeypatch):
+        """Light-client read stampede (ISSUE 16, docs/proof-serving.md):
+        scripted bursts of thousands of tx/header/valset proof queries
+        against a 512-slot proof queue while consensus runs.  The read
+        plane must coalesce same-height queries into single tree builds,
+        shed ONLY proof traffic (consensus-class verify shed stays 0 —
+        a shed proof costs the coalescing win, never the response), and
+        consensus hashing must ride the device tree seam throughout."""
+        monkeypatch.setenv("COMETBFT_TPU_TRACE", "1")  # dump asserts below
+        before = self._snapshot_globals()
+        res = run_scenario(
+            "light-stampede", 3, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        # consensus untouched by the read flood
+        assert res.sched["shed"]["consensus"] == 0, res.sched
+        p = res.proofs
+        assert p["queries_total"] > 0, p
+        # every kind was queried and served
+        for kind in ("tx", "header", "valset"):
+            assert p["queries"][kind] > 0, p
+        # coalescing: far fewer tree builds than admitted queries
+        assert 0 < p["tree_builds_total"] < p["queries_total"] / 10, p
+        assert p["queries_per_flush"] > 100, p
+        # the bursts overflow the 512-slot queue: proof shed happened,
+        # and the first shed dumped the flight recorder
+        assert p["shed_total"] > 0, p
+        assert res.spans["anomalies"].get("proof_shed", 0) > 0, res.spans
+        assert any(
+            "proof_shed" in d["file"] for d in res.spans["dumps"]
+        ), res.spans
+        # consensus hashing rode the device tree seam (host runner in
+        # sim), never the untracked host path, with zero faults
+        assert p["trees_device"] > 0, p
+        assert p["trees_host"] == 0, p
+        assert p["device_fallbacks"] == 0, p
+        assert p["serial_fallbacks"] == 0, p
+        # nothing left hanging: the teardown drained the server
+        assert p["queue_depth"] == 0, p
+        assert "merkle.tree" in res.spans["stages"], res.spans["stages"]
+        assert "proof.flush" in res.spans["stages"], res.spans["stages"]
+        assert self._snapshot_globals() == before
+
+    @pytest.mark.slow
+    def test_light_stampede_deterministic(self, tmp_path):
+        """Same seed => byte-identical traces with the proof server in
+        the loop: flush grouping is paused/resumed around each scripted
+        burst so shed and build counts are a pure function of the seed
+        even with the dispatcher thread running.  (Slow lane: doubles a
+        whole scenario run — the PR-1/PR-3 precedent.)"""
+        a = run_scenario("light-stampede", 17, root=tmp_path / "a")
+        b = run_scenario("light-stampede", 17, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert a.heights == b.heights
+        assert a.proofs == b.proofs
+
     @pytest.mark.slow
     def test_tx_flood_deterministic(self, tmp_path):
         """Same seed => byte-identical traces with batched admission in

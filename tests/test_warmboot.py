@@ -17,12 +17,13 @@ from cometbft_tpu.ops import warm_stats, warmboot
 
 @pytest.fixture(autouse=True)
 def _clean(monkeypatch):
-    # pin the secp/BLS extra matrices EMPTY for the legacy ed25519-matrix
-    # tests: their run() calls would otherwise really compile the ladder
-    # and G1 kernels (~30s/shape on this host).  TestExtraMatrix re-enables
-    # them against a monkeypatched warm seam.
+    # pin the secp/BLS/merkle extra matrices EMPTY for the legacy
+    # ed25519-matrix tests: their run() calls would otherwise really
+    # compile the ladder, G1 and tree kernels (~30s/shape on this host).
+    # TestExtraMatrix re-enables them against a monkeypatched warm seam.
     monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "")
     monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
+    monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", "")
     backend_health.reset()
     warmboot.reset()
     yield
@@ -219,6 +220,9 @@ class TestExtraMatrix:
     def test_default_families_and_env_bounds(self, monkeypatch):
         monkeypatch.delenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", raising=False)
         monkeypatch.delenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", raising=False)
+        monkeypatch.delenv(
+            "COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", raising=False
+        )
         shapes = warmboot.extra_matrix()
         assert [
             s for br, f, s in shapes if f == "secp-ladder"
@@ -226,16 +230,24 @@ class TestExtraMatrix:
         assert [
             s for br, f, s in shapes if f == "bls-g1"
         ] == sorted(warmboot.DEFAULT_BLS_BUCKETS)
+        assert [
+            s for br, f, s in shapes if f == "sha256-tree"
+        ] == sorted(warmboot.DEFAULT_MERKLE_BUCKETS)
         assert {br for br, f, _ in shapes if f == "secp-ladder"} == {
             "secp_device"
         }
         assert {br for br, f, _ in shapes if f == "bls-g1"} == {"bls_g1"}
+        assert {br for br, f, _ in shapes if f == "sha256-tree"} == {
+            "merkle_device"
+        }
         # env override bounds each family; empty skips it entirely
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "4,2")
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", "8,32")
         shapes = warmboot.extra_matrix()
         assert [s for _, f, s in shapes if f == "secp-ladder"] == [2, 4]
         assert not [s for _, f, s in shapes if f == "bls-g1"]
+        assert [s for _, f, s in shapes if f == "sha256-tree"] == [8, 32]
 
     def _fake_exec(self, calls):
         def fake(backend, bucket, donated=None):
