@@ -1193,6 +1193,122 @@ def run_meshfault(emit, n=256, reps=3, width=4) -> dict:
     return rec
 
 
+def run_multichip(emit, n=10240, depth=None) -> dict:
+    """Multi-lane in-flight pipeline stage (docs/verify-scheduler.md
+    "In-flight pipeline"): the headline 10,240-signature commit shape
+    chunked across the elastic mesh lanes with K chunk dispatches in
+    flight (``ops.verify.verify_pipelined``), on the per-shard
+    host-oracle runner seam so the dispatch counts are deterministic and
+    platform-independent.  Asserted hard:
+
+      * verdicts bitwise-equal to the host ZIP-215 oracle (three corrupt
+        lanes attributed at their exact indices);
+      * the pipeline genuinely overlaps: the in-flight high-water mark
+        reaches the configured depth K, so ``inflight_occupancy`` is
+        deterministically 1.0 (trend-gated via the ``*occupancy*``
+        higher-is-better pattern);
+      * every chunk lands on a lane (lane_dispatches covers the width).
+
+    ``commit10k_ms`` walls stay advisory on the throttled host.  Emitted
+    as stage="multichip" and written to BENCH_MULTICHIP.json for the
+    bench_trend gate.  Skips cleanly (no record, no JSON) when jax
+    reports < 2 devices — the gate stage forces an 8-device CPU mesh via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``."""
+    import numpy as np
+
+    try:
+        import jax
+
+        n_devs = len(jax.devices())
+    except Exception:  # noqa: BLE001 — no backend at all: single chip
+        n_devs = 1
+    if n_devs < 2:
+        print(
+            "bench --multichip: skipped (1 jax device; force a virtual "
+            "mesh with XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+        return {}
+    width = min(n_devs, 8)
+
+    from cometbft_tpu.crypto import backend_health
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.ops import dispatch_stats
+    from cometbft_tpu.ops import verify as ov
+    from cometbft_tpu.parallel import elastic
+
+    # commit-shaped batch: 64 distinct signed triples tiled to n (device
+    # work is data-independent per lane), three corrupt lanes spread
+    # head / middle / tail so attribution is exercised across chunks
+    distinct = min(n, 64)
+    pubs, msgs, sigs = [], [], []
+    for i in range(distinct):
+        seed = bytes([(i % 255) + 1]) * 32
+        pub = ref.pubkey_from_seed(seed)
+        msg = b"bench-multichip-%d" % i
+        pubs.append(pub)
+        msgs.append(msg)
+        sigs.append(ref.sign(seed, msg))
+    reps = -(-n // distinct)
+    pubs = (pubs * reps)[:n]
+    msgs = (msgs * reps)[:n]
+    sigs = list((sigs * reps)[:n])
+    bad = (0, n // 2, n - 1)
+    for i in bad:
+        sigs[i] = sigs[i][:-1] + bytes([sigs[i][-1] ^ 1])
+    expected = np.ones(n, dtype=bool)
+    expected[list(bad)] = False
+
+    k = int(depth) if depth else max(width, 2)
+    backend_health.reset()
+    elastic.clear()
+    elastic.configure(range(width))
+    elastic.set_mesh_runner(elastic.host_oracle_runner)
+    try:
+        dispatch_stats.reset()
+        t0 = time.perf_counter()
+        bits = ov.verify_pipelined(pubs, msgs, sigs, inflight=k)
+        wall = time.perf_counter() - t0
+        assert (bits == expected).all(), "verdicts diverged from oracle"
+        snap = dispatch_stats.snapshot()
+    finally:
+        elastic.clear()
+        backend_health.reset()
+
+    hwm = snap["inflight_hwm"]
+    lane_disp = snap.get("lane_dispatches", {})
+    chunks = sum(lane_disp.values())
+    rec = {
+        "metric": "multichip_pipeline",
+        "stage": "multichip",
+        "batch": n,
+        "lanes": width,
+        "inflight_depth": k,
+        "inflight_hwm": hwm,
+        "inflight_occupancy": round(hwm / float(k), 3),
+        "chunks": chunks,
+        "lanes_used": len(lane_disp),
+        "commit10k_ms": round(wall * 1e3, 3),
+        "sigs_per_s": round(n / wall, 1),
+    }
+    emit(rec)
+    # hard invariants (occupancy + lane coverage; walls stay advisory)
+    assert hwm == min(k, chunks), (
+        f"pipeline under-filled: hwm {hwm}, depth {k}, chunks {chunks}"
+    )
+    assert chunks >= width, (chunks, width)
+    assert len(lane_disp) == width, (
+        f"round-robin missed lanes: {sorted(lane_disp)} of {width}"
+    )
+    out = os.path.join(REPO, "BENCH_MULTICHIP.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return rec
+
+
 def run_proofserve(
     emit, n_queries=10000, n_heights=32, txs_per_block=64, sample=2000
 ) -> dict:
@@ -2306,6 +2422,18 @@ def main() -> None:
         "BENCH_MESHFAULT_BATCH / _WIDTH size the run",
     )
     ap.add_argument(
+        "--multichip",
+        action="store_true",
+        help="run only the multi-lane in-flight pipeline stage: the "
+        "10240-signature commit shape chunked across mesh lanes with K "
+        "dispatches in flight (verify_pipelined) on the host-oracle "
+        "shard runner — oracle-equal verdicts, full in-flight occupancy "
+        "and lane coverage asserted hard, commit10k_ms advisory; writes "
+        "BENCH_MULTICHIP.json for the bench_trend gate; skips when jax "
+        "reports < 2 devices; BENCH_MULTICHIP_BATCH / _INFLIGHT size "
+        "the run",
+    )
+    ap.add_argument(
         "--proofserve",
         action="store_true",
         help="run only the coalesced proof-serving stage: N tx-proof "
@@ -2418,6 +2546,15 @@ def main() -> None:
             _emit,
             n=int(os.environ.get("BENCH_MESHFAULT_BATCH", "256")),
             width=int(os.environ.get("BENCH_MESHFAULT_WIDTH", "4")),
+        )
+    elif args.multichip:
+        # the shard work runs on the host-oracle runner seam; jax is
+        # probed only for the device count (skip on single-chip hosts)
+        run_multichip(
+            _emit,
+            n=int(os.environ.get("BENCH_MULTICHIP_BATCH", "10240")),
+            depth=int(os.environ.get("BENCH_MULTICHIP_INFLIGHT", "0"))
+            or None,
         )
     elif args.proofserve:
         # jax-free by construction (host tree-runner seam): no

@@ -55,6 +55,15 @@ def _zero() -> dict:
         "mesh_width": 0,
         "mesh_shrinks": 0,
         "mesh_restores": 0,
+        # in-flight pipeline (docs/verify-scheduler.md "In-flight
+        # pipeline"): dispatches whose fetch has not resolved yet, the
+        # high-water mark since reset, and per-lane dispatch/lane-usage
+        # tallies (lane = mesh ordinal or supervisor backend name)
+        "inflight_depth": 0,
+        "inflight_hwm": 0,
+        "lane_dispatches": {},  # lane (str) -> dispatches routed there
+        "lane_lanes_total": {},  # lane (str) -> padded lanes shipped
+        "lane_lanes_used": {},  # lane (str) -> lanes carrying a signature
     }
 
 
@@ -124,6 +133,43 @@ def mesh_width() -> int:
         return _STATS["mesh_width"]
 
 
+def record_inflight_enter() -> int:
+    """A dispatch left for the device without blocking on its verdict.
+    Returns the depth INCLUDING this dispatch (for span attribution)."""
+    with _LOCK:
+        _STATS["inflight_depth"] += 1
+        d = _STATS["inflight_depth"]
+        if d > _STATS["inflight_hwm"]:
+            _STATS["inflight_hwm"] = d
+        return d
+
+
+def record_inflight_exit() -> None:
+    """The matching fetch resolved (or failed definitively)."""
+    with _LOCK:
+        _STATS["inflight_depth"] = max(0, _STATS["inflight_depth"] - 1)
+
+
+def inflight_hwm() -> int:
+    with _LOCK:
+        return _STATS["inflight_hwm"]
+
+
+def record_lane_dispatch(lane: str, lanes_total: int, lanes_used: int) -> None:
+    """Per-lane routing tally for the in-flight pipeline: ``lane`` is a
+    mesh ordinal (str) or a supervisor backend name.  Occupancy per lane
+    (lanes_used / lanes_total) derives at snapshot time, rendered as
+    ``cometbft_crypto_lane_occupancy{lane=}``."""
+    key = str(lane)
+    with _LOCK:
+        d = _STATS["lane_dispatches"]
+        d[key] = d.get(key, 0) + 1
+        t = _STATS["lane_lanes_total"]
+        t[key] = t.get(key, 0) + int(lanes_total)
+        u = _STATS["lane_lanes_used"]
+        u[key] = u.get(key, 0) + int(lanes_used)
+
+
 def record_fused(n_segments: int) -> None:
     with _LOCK:
         _STATS["fused_batches"] += 1
@@ -158,6 +204,12 @@ def snapshot() -> dict:
     out["occupancy"] = (
         out["lanes_used"] / out["lanes_total"] if out["lanes_total"] else 0.0
     )
+    out["lane_occupancy"] = {
+        lane: (
+            out["lane_lanes_used"].get(lane, 0) / total if total else 0.0
+        )
+        for lane, total in out["lane_lanes_total"].items()
+    }
     return out
 
 

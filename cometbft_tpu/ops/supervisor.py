@@ -686,3 +686,216 @@ def verify_batches_overlapped_supervised(work) -> list:
         br.record_success()
         out.append((accept & structural)[:n])
     return out
+
+
+# -- in-flight dispatch/fetch seam (docs/verify-scheduler.md) -----------------
+#
+# The async half of ``verify_supervised``: ``dispatch_verify`` routes one
+# batch toward a mesh lane or the single-chip chain WITHOUT blocking on its
+# verdict, and ``fetch_verify`` resolves it later (the verifysched
+# completion pool / ``ops.verify.verify_pipelined``).  Every failure mode
+# at fetch time degrades exactly like the synchronous path — a wedged or
+# failed lane/backend is demoted alone and the batch re-verifies on the
+# single-chip chain (host floor), so accept bits stay definitive verdicts.
+
+
+class _InflightVerify:
+    """One supervised verify in flight between dispatch and fetch.
+
+    Kinds:
+      * ``lane``       — routed at one healthy mesh ordinal
+        (``elastic.dispatch_lane``; the shard runs at fetch time on the
+        completion pool, under the shard watchdog);
+      * ``chip``       — a real async device dispatch already in the
+        device queue (unfetched device array + injector transform);
+      * ``deferred``   — the device-runner seam is installed (sim/tests):
+        the whole ``_attempt`` runs at fetch time, so overlap — and the
+        injector's raise/hang — happen on the completion pool;
+      * ``supervised`` — fully degraded at dispatch time (or the dispatch
+        itself failed): fetch walks ``verify_supervised`` with ``skip``.
+    """
+
+    __slots__ = (
+        "kind", "pubs", "msgs", "sigs", "n", "lanes", "backend",
+        "lane", "lane_handle", "dev", "transform", "structural", "skip",
+    )
+
+    def __init__(self, pubs, msgs, sigs):
+        self.kind = "supervised"
+        self.pubs = pubs
+        self.msgs = msgs
+        self.sigs = sigs
+        self.n = len(pubs)
+        self.lanes = 0
+        self.backend = None
+        self.lane = None
+        self.lane_handle = None
+        self.dev = None
+        self.transform = None
+        self.structural = None
+        self.skip = ()
+
+
+def dispatch_verify(pubs, msgs, sigs, lane=None) -> _InflightVerify:
+    """Route one batch without blocking on its verdict.  ``lane`` (a mesh
+    ordinal) pins it at that lane when the elastic mesh is active and the
+    lane is healthy; otherwise the first breaker-allowed device backend
+    takes it.  Pair every handle with exactly one ``fetch_verify`` —
+    in-flight depth accounting (``dispatch_stats``) balances on fetch."""
+    from cometbft_tpu.ops import verify as ov
+
+    pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
+    h = _InflightVerify(pubs, msgs, sigs)
+    n = h.n
+    dispatch_stats.record_inflight_enter()
+    try:
+        if lane is not None:
+            from cometbft_tpu.parallel import elastic
+
+            if elastic.active() and int(lane) in elastic.healthy_ordinals():
+                h.kind = "lane"
+                h.lane = int(lane)
+                h.lane_handle = elastic.dispatch_lane(
+                    h.lane, pubs, msgs, sigs
+                )
+                h.lanes = h.lane_handle.lanes
+                dispatch_stats.record_lane_dispatch(str(h.lane), h.lanes, n)
+                return h
+        reg = backend_health.registry()
+        backend = None
+        for b in device_chain():
+            if reg.breaker(b).allow():
+                backend = b
+                break
+        if backend is None:
+            # fully degraded: fetch walks the chain (host floor answers)
+            dispatch_stats.record_lane_dispatch(HOST_BACKEND, max(n, 1), n)
+            return h
+        h.backend = backend
+        min_b = (
+            ov._PALLAS_MIN_BUCKET if backend == "pallas" else ov._BUCKETS[0]
+        )
+        if _DEVICE_RUNNER is not None:
+            # device-runner seam: the stand-in runs synchronously, so the
+            # only way it can overlap is to defer it to the completion
+            # pool entirely — which also puts the injector's raise/hang
+            # where a real device fault would surface: at fetch
+            h.kind = "deferred"
+            h.lanes = ov.bucket_size(max(n, 1), min_b)
+            dispatch_stats.record_lane_dispatch(backend, h.lanes, n)
+            return h
+        arrays, _, structural = ov.prepare_batch(pubs, msgs, sigs, min_b)
+        lanes = arrays["s_ok"].shape[0]
+        inj = _FAULT_INJECTOR
+
+        def dispatch():
+            import jax.numpy as jnp
+
+            transform = (
+                inj(backend, pubs, msgs, sigs) if inj is not None else None
+            )
+            dispatch_stats.record_dispatch(lanes, n)
+            call, _ = ov.bucket_executable(backend, lanes)
+            return (
+                call(**{k: jnp.asarray(v) for k, v in arrays.items()}),
+                transform,
+            )
+
+        try:
+            with tracing.span(
+                "verify.dispatch", tier=backend, lanes=lanes, n=n,
+                pipelined=True,
+            ):
+                h.dev, h.transform = watchdog_call(dispatch, backend=backend)
+        except Exception as e:  # noqa: BLE001 — dispatch failure demotes;
+            # the batch re-verifies on the next tier at fetch time
+            reg.breaker(backend).record_failure(e)
+            reg.record_demotion(backend)
+            logger.warning(
+                "crypto backend %s pipelined dispatch failed (%r); batch "
+                "will re-verify on the next tier at fetch",
+                backend,
+                e,
+            )
+            h.backend = None
+            h.skip = (backend,)
+            return h
+        h.kind = "chip"
+        h.lanes = lanes
+        h.structural = structural
+        dispatch_stats.record_lane_dispatch(backend, lanes, n)
+        return h
+    except BaseException:
+        # a dispatch that never produced a handle must not leak depth
+        dispatch_stats.record_inflight_exit()
+        raise
+
+
+def fetch_verify(h: _InflightVerify) -> np.ndarray:
+    """Resolve one in-flight verify: (n,) bool accept bits.  Cannot raise
+    for infrastructure reasons — every failure mode degrades the guilty
+    lane/backend alone and re-verifies on the single-chip chain, whose
+    floor is the host ZIP-215 oracle."""
+    reg = backend_health.registry()
+    try:
+        if h.kind == "lane":
+            from cometbft_tpu.parallel import elastic
+
+            try:
+                return elastic.fetch_lane(h.lane_handle)
+            except Exception as e:  # noqa: BLE001 — lane degrades alone
+                if isinstance(e, elastic.ShardFailure):
+                    ordinal, err = e.ordinal, e.err
+                else:
+                    ordinal, err = h.lane, e
+                width = max(0, len(elastic.healthy_ordinals()) - 1)
+                elastic.note_lane_failure(ordinal, err, width)
+                return verify_supervised(h.pubs, h.msgs, h.sigs, mesh=False)
+        if h.kind == "deferred":
+            br = reg.breaker(h.backend)
+            try:
+                bits = _attempt(h.backend, h.pubs, h.msgs, h.sigs)
+            except Exception as e:  # noqa: BLE001 — any dispatch error
+                br.record_failure(e)
+                reg.record_demotion(h.backend)
+                logger.warning(
+                    "crypto backend %s pipelined verify failed (%r); "
+                    "retrying on the next verify tier",
+                    h.backend,
+                    e,
+                )
+                return verify_supervised(
+                    h.pubs, h.msgs, h.sigs, skip=(h.backend,), mesh=False
+                )
+            br.record_success()
+            return bits
+        if h.kind == "chip":
+            br = reg.breaker(h.backend)
+
+            def fetch():
+                a = np.asarray(h.dev)
+                return h.transform(a) if h.transform is not None else a
+
+            try:
+                t0 = time.perf_counter()
+                with tracing.span(
+                    "verify.fetch", tier=h.backend, lanes=h.lanes, n=h.n
+                ):
+                    got = watchdog_call(fetch, backend=h.backend)
+                dispatch_stats.record_dispatch_time(
+                    h.backend, h.lanes, time.perf_counter() - t0
+                )
+                accept = _validate_accept(got, h.lanes)
+            except Exception as e:  # noqa: BLE001 — fetch failure demotes
+                br.record_failure(e)
+                reg.record_demotion(h.backend)
+                return verify_supervised(
+                    h.pubs, h.msgs, h.sigs, skip=(h.backend,), mesh=False
+                )
+            br.record_success()
+            return (accept & h.structural)[: h.n]
+        return verify_supervised(
+            h.pubs, h.msgs, h.sigs, skip=h.skip, mesh=False
+        )
+    finally:
+        dispatch_stats.record_inflight_exit()

@@ -185,6 +185,36 @@ def healthy_width() -> int:
     )
 
 
+def healthy_ordinals() -> "list[int]":
+    """Ordinals a new lane dispatch may target (CLOSED breakers only — a
+    read, never a probe; the mesh-wide ``_membership`` walk owns probe
+    slots).  Empty when the mesh is inactive.  jax-free: the verifysched
+    dispatcher round-robins its in-flight flushes over this list."""
+    o = _ORDINALS
+    if o is None or not enabled():
+        return []
+    reg = backend_health.registry()
+    return [
+        ordinal
+        for ordinal in o
+        if reg.breaker(breaker_name(ordinal)).state == backend_health.CLOSED
+    ]
+
+
+def admit_ordinals() -> "list[int]":
+    """Ordinals a NEW lane dispatch may target, probes included: the
+    same membership walk a mesh-wide dispatch runs (``_membership``) —
+    CLOSED breakers join directly, a HALF_OPEN ordinal spends its probe
+    slot on the one-bucket re-admission probe and joins only if it
+    passes.  This is what the pipelined verifysched dispatcher calls per
+    flush: without it, lane round-robin would orbit the healthy subset
+    forever and an excluded chip could never re-earn its lane.  Empty
+    when the mesh is inactive."""
+    if _ORDINALS is None or not enabled():
+        return []
+    return _membership(set())
+
+
 def _note_width(w: int) -> None:
     # unconditionally (one locked int store): a change-detection cache
     # here would desync from dispatch_stats.reset(), leaving the gauge at
@@ -523,6 +553,115 @@ def _attempt(devs: "list[int]", pubs, msgs, sigs) -> np.ndarray:
     return bits[:n]
 
 
+# -- single-lane dispatch/fetch seam (in-flight pipeline) ---------------------
+
+
+class _LaneHandle:
+    """One lane's deferred shard work (docs/verify-scheduler.md
+    "In-flight pipeline").  ``run_single_shard`` blocks on the device
+    result inside its jitted call, so the device work itself executes at
+    ``fetch_lane`` time on the completion pool — the dispatch records the
+    routing decision and returns immediately, which is what lets the
+    dispatcher keep K lanes busy concurrently."""
+
+    __slots__ = ("ordinal", "pubs", "msgs", "sigs", "n", "lanes", "t0")
+
+    def __init__(self, ordinal, pubs, msgs, sigs, n, lanes, t0):
+        self.ordinal = ordinal
+        self.pubs = pubs
+        self.msgs = msgs
+        self.sigs = sigs
+        self.n = n
+        self.lanes = lanes
+        self.t0 = t0
+
+
+def dispatch_lane(ordinal: int, pubs, msgs, sigs) -> _LaneHandle:
+    """Route one batch at a single mesh lane without blocking on its
+    verdict.  Pair with ``fetch_lane``; a failed/wedged lane surfaces
+    there as ``ShardFailure`` so the fetcher can degrade THAT lane alone
+    (``note_lane_failure``) and re-verify on the single-chip chain."""
+    from cometbft_tpu.ops import verify as ov
+
+    pubs, msgs, sigs = list(pubs), list(msgs), list(sigs)
+    n = len(pubs)
+    lanes = ov.bucket_size(max(n, 1), ov._min_bucket())
+    dispatch_stats.record_dispatch(lanes, n)
+    return _LaneHandle(
+        int(ordinal), pubs, msgs, sigs, n, lanes, time.perf_counter()
+    )
+
+
+def fetch_lane(h: _LaneHandle) -> np.ndarray:
+    """Resolve one lane dispatch: the shard runs under the shard watchdog
+    with the fault injector consulted, exactly like a shard of a mesh-wide
+    dispatch.  Returns (n,) bool accept bits; raises ``ShardFailure``
+    (ordinal-attributed) on any infrastructure problem."""
+    ts = time.perf_counter()
+    with tracing.span(
+        "mesh.shard", device=h.ordinal, lanes=h.lanes, tier="lane"
+    ) as sp:
+        try:
+            out = np.asarray(
+                _run_shard(h.ordinal, h.pubs, h.msgs, h.sigs, h.lanes)
+            )
+            if out.shape != (h.lanes,) or out.dtype != np.bool_:
+                raise backend_health.BackendOutputError(
+                    f"mesh lane {h.ordinal} returned shape {out.shape} "
+                    f"dtype {out.dtype}, want ({h.lanes},) bool"
+                )
+        except ShardFailure:
+            raise
+        except Exception as e:
+            raise ShardFailure(h.ordinal, e) from e
+        sp.set(ok=int(out.sum()))
+    dt = time.perf_counter() - ts
+    dispatch_stats.record_shard_time("lane", h.ordinal, h.lanes, dt)
+    dispatch_stats.record_dispatch_time("lane", h.lanes, dt)
+    # a clean lane resets the ordinal's consecutive-failure count, exactly
+    # like a participant in a clean mesh-wide dispatch
+    backend_health.registry().breaker(
+        breaker_name(h.ordinal)
+    ).record_success()
+    return out[: h.n]
+
+
+def note_lane_failure(ordinal: int, err: BaseException, width: int) -> None:
+    """Record one lane/shard failure on every observability rail: breaker
+    failure + demotion for THAT ordinal, shrink counters, anomalies and
+    the ``mesh.reconfig`` journal event.  Shared by the mesh-wide shrink
+    ladder (``verify_elastic``) and the in-flight pipeline's per-lane
+    degradation (``ops/supervisor.fetch_verify``).  ``width`` is the
+    healthy width the NEXT dispatch will see (after this exclusion)."""
+    name = breaker_name(ordinal)
+    reg = backend_health.registry()
+    if isinstance(err, backend_health.DispatchTimeoutError):
+        tracing.record_anomaly(
+            "shard_watchdog_fire", ordinal=int(ordinal), width=width
+        )
+    reg.breaker(name).record_failure(err)
+    reg.record_demotion(name)
+    dispatch_stats.record_mesh_shrink()
+    tracing.record_anomaly(
+        "mesh_shrink",
+        ordinal=int(ordinal),
+        width=width,
+        error=type(err).__name__,
+    )
+    tracing.note_event(
+        "mesh.reconfig",
+        width=width,
+        excluded=int(ordinal),
+        reason="shard-failure",
+    )
+    logger.warning(
+        "mesh shard on ordinal %d failed (%r); shrinking to %d devices",
+        ordinal,
+        err,
+        width,
+    )
+
+
 # -- the elastic verify entry -------------------------------------------------
 
 
@@ -555,35 +694,8 @@ def verify_elastic(pubs, msgs, sigs) -> np.ndarray:
                 reg.breaker(breaker_name(o)).record_success()
             return bits
         except ShardFailure as e:
-            name = breaker_name(e.ordinal)
-            if isinstance(e.err, backend_health.DispatchTimeoutError):
-                tracing.record_anomaly(
-                    "shard_watchdog_fire", ordinal=e.ordinal,
-                    width=len(devs),
-                )
-            reg.breaker(name).record_failure(e.err)
-            reg.record_demotion(name)
             banned.add(e.ordinal)
-            dispatch_stats.record_mesh_shrink()
-            tracing.record_anomaly(
-                "mesh_shrink",
-                ordinal=e.ordinal,
-                width=len(devs) - 1,
-                error=type(e.err).__name__,
-            )
-            tracing.note_event(
-                "mesh.reconfig",
-                width=len(devs) - 1,
-                excluded=e.ordinal,
-                reason="shard-failure",
-            )
-            logger.warning(
-                "mesh shard on ordinal %d failed (%r); shrinking to %d "
-                "devices and re-dispatching",
-                e.ordinal,
-                e.err,
-                len(devs) - 1,
-            )
+            note_lane_failure(e.ordinal, e.err, len(devs) - 1)
             continue
         except Exception as e:  # noqa: BLE001 — non-attributable mesh
             # failure (lowering, collective, compile): no ordinal to

@@ -333,6 +333,8 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_VERIFY_SCHED",
     "COMETBFT_TPU_SCHED_FLUSH_US",
     "COMETBFT_TPU_SCHED_QUEUE",
+    "COMETBFT_TPU_SCHED_PIPELINE",
+    "COMETBFT_TPU_SCHED_INFLIGHT",
     "COMETBFT_TPU_TXINGEST",
     "COMETBFT_TPU_TXINGEST_QUEUE",
     "COMETBFT_TPU_TXINGEST_BATCH",
@@ -867,6 +869,95 @@ def _gossip_burst(s: Scenario) -> list[Action]:
     return [Action(0.0, "storm links: dup 25%, reorder 50%", storm)] + [
         Action(float(t), "bulk verify burst (256 items)", burst)
         for t in (3, 5, 7)
+    ]
+
+
+def _pipeline_burst(s: Scenario) -> list[Action]:
+    """In-flight verify pipeline under deterministic load
+    (docs/verify-scheduler.md "In-flight pipeline"): two paused bulk
+    rounds submitted back-to-back while the completion pool is gated
+    shut, so the dispatcher MUST ship the second fused flush while the
+    first is still in flight (depth 2 — the pipelined high-water mark is
+    captured in ScenarioResult.sched).  Every future still resolves with
+    the definitive verdict before the action logs, so the byte-compared
+    trace cannot depend on completion-pool timing."""
+
+    def burst(c: SimCluster) -> None:
+        import hashlib
+        import threading
+        import time as _time
+
+        from cometbft_tpu import verifysched
+        from cometbft_tpu.verifysched import stats as sstats
+
+        sched = verifysched.get_scheduler()
+        tag = b"pipeline-burst-%d-%d" % (c.seed, int(c.clock.now() * 1000))
+        futs = []
+        gate = threading.Event()
+        orig = supervisor._DEVICE_RUNNER
+        if orig is not None:
+            # park the completion pool on the gate so the overlap is
+            # deterministic, not a race the CI host may lose (slow lane
+            # runs the real kernel and skips the gating)
+            def gated(backend, pubs, msgs, sigs, lanes):
+                gate.wait(20)
+                return orig(backend, pubs, msgs, sigs, lanes)
+
+            supervisor.set_device_runner(gated)
+        try:
+            # two paused rounds -> two separate drains -> two flushes;
+            # flush B dispatches while flush A sits gated in flight
+            for half in (b"a", b"b"):
+                sched.pause()
+                try:
+                    for i in range(40):
+                        h = hashlib.sha256(
+                            tag + b"-" + half + b"-%d" % i
+                        ).digest()
+                        futs.append(
+                            sched.submit(
+                                h,  # structurally valid, crypto garbage
+                                b"pipe-msg-%d" % i,
+                                h + h,
+                                verifysched.PRIO_BLOCKSYNC,
+                            )
+                        )
+                finally:
+                    sched.resume()
+                if half == b"a" and orig is not None:
+                    # don't let round b land in round a's drain: wait
+                    # until flush A is dispatched (the gate pins it in
+                    # flight), so round b forces a SECOND fused flush
+                    deadline = _time.monotonic() + 10
+                    while (
+                        sstats.snapshot()["inflight_depth"] < 1
+                        and _time.monotonic() < deadline
+                    ):
+                        _time.sleep(0.002)
+            if orig is not None:
+                deadline = _time.monotonic() + 10
+                while (
+                    sstats.snapshot()["inflight_depth"] < 2
+                    and _time.monotonic() < deadline
+                ):
+                    _time.sleep(0.002)
+            gate.set()
+            # block on EVERY future before logging: nothing timing-
+            # dependent may precede the byte-compared trace line
+            for f in futs:
+                assert f.result(timeout=30) is False
+        finally:
+            gate.set()
+            if orig is not None:
+                supervisor.set_device_runner(orig)
+        c._log(
+            "scenario: pipelined burst of %d submissions resolved"
+            % len(futs)
+        )
+
+    return [
+        Action(float(t), "pipelined bulk burst (2x40 items)", burst)
+        for t in (3, 5)
     ]
 
 
@@ -1714,6 +1805,28 @@ SCENARIOS: dict[str, Scenario] = {
                 {
                     "COMETBFT_TPU_VERIFY_SCHED": "1",
                     "COMETBFT_TPU_SCHED_QUEUE": "48",
+                    "COMETBFT_TPU_SCHED_FLUSH_US": "500",
+                }
+            ),
+            teardown=_backend_faults_teardown,
+        ),
+        Scenario(
+            "pipeline-burst",
+            "in-flight verify pipeline: back-to-back paused bulk rounds "
+            "with the completion pool gated, so two fused flushes must "
+            "genuinely overlap (in-flight depth 2) while consensus keeps "
+            "committing; every future resolves with the definitive "
+            "verdict and traces stay byte-identical per seed with the "
+            "completion pool in the loop.  Runs on the host-oracle "
+            "device-runner seam so tier-1 never pays real XLA dispatches",
+            target_height=6,
+            max_time=180.0,
+            actions=_pipeline_burst,
+            setup=_backend_faults_setup(
+                {
+                    "COMETBFT_TPU_VERIFY_SCHED": "1",
+                    "COMETBFT_TPU_SCHED_PIPELINE": "1",
+                    "COMETBFT_TPU_SCHED_INFLIGHT": "2",
                     "COMETBFT_TPU_SCHED_FLUSH_US": "500",
                 }
             ),

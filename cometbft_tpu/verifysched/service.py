@@ -76,6 +76,12 @@ DEFAULT_QUEUE_CAP = 8192
 # never exceeds it — leftovers stay queued for the next flush
 MAX_DRAIN = 32768
 
+# ops.verify._BUCKETS mirror for the ``_bucket_target`` fallback path: if
+# the ops import itself fails, the width-scaled target must STILL clamp to
+# a real padding bucket — a non-bucket target would deliberately wait for
+# a strictly worse-padded flush
+_FALLBACK_BUCKETS = (32, 64, 128, 256, 512, 1024, 4096, 8192, 10240, 32768)
+
 
 class QueueFullError(Exception):
     """Admission control rejected a non-consensus submission (backpressure).
@@ -113,6 +119,38 @@ def scheduler_active() -> bool:
     """True when submissions should take the scheduler path: kill switch
     on AND the batch backend trusted (``backend_trusted``)."""
     return enabled() and backend_trusted()
+
+
+def pipeline_enabled() -> bool:
+    """In-flight pipelining (docs/verify-scheduler.md "In-flight
+    pipeline"): the dispatcher ships flush i+1 while flush i is still on
+    the device, and one completion thread resolves verdicts in drain
+    order.  ``COMETBFT_TPU_SCHED_PIPELINE=0`` restores the single-flight
+    dispatcher bit-for-bit."""
+    return os.environ.get("COMETBFT_TPU_SCHED_PIPELINE", "1") != "0"
+
+
+def inflight_target() -> int:
+    """Bound on concurrently dispatched flushes: explicit
+    ``COMETBFT_TPU_SCHED_INFLIGHT`` wins; the default is the LIVE elastic
+    mesh width (each healthy lane carries its own dispatch, and the bound
+    follows shrinks/restores automatically — ``healthy_width`` is
+    jax-free) with a floor of 2 on a single chip, where the depth buys
+    host-prep/device-compute overlap rather than lane parallelism."""
+    env = os.environ.get("COMETBFT_TPU_SCHED_INFLIGHT")
+    if env:
+        try:
+            return max(int(env), 1)
+        except ValueError:
+            pass
+    try:
+        from cometbft_tpu.parallel import elastic
+
+        w = elastic.healthy_width()
+    except Exception:  # noqa: BLE001 — mesh introspection is never
+        # load-bearing for the flush loop
+        w = 0
+    return max(w, 2)
 
 
 # -- per-thread priority class ----------------------------------------------
@@ -207,7 +245,22 @@ class VerifyScheduler:
         self._stopped = False
         self._paused = False
         self._full_target: Optional[int] = None
-        self._last_flush_t: Optional[float] = None  # flush-interval histo
+        # flush-interval histo — stamped at DISPATCH SUBMISSION on the
+        # dispatcher thread (monotonic there), never from item drain
+        # times: with K flushes in flight, drain-time deltas could go
+        # negative or interleave
+        self._last_flush_t: Optional[float] = None
+        # in-flight pipeline state (pipeline_enabled()): FIFO of
+        # dispatched-but-unfetched flushes, resolved in drain order by
+        # one completion thread; _fcond has its OWN lock so a waiting
+        # dispatcher never blocks submitters
+        self._flock = threading.Lock()
+        self._fcond = threading.Condition(self._flock)
+        self._fetch_queue: "deque[tuple]" = deque()
+        self._inflight = 0
+        self._fetch_thread: Optional[threading.Thread] = None
+        self._fetch_stop = False
+        self._lane_rr = 0  # round-robin over healthy mesh ordinals
 
     # -- submission -------------------------------------------------------
 
@@ -341,6 +394,22 @@ class VerifyScheduler:
                     "global state exists when it unwedges",
                     timeout_s,
                 )
+        # the dispatcher is down (no new enqueues); now drain the
+        # completion pool — it exits only once the in-flight FIFO is empty,
+        # so every dispatched flush still resolves its futures
+        with self._fcond:
+            self._fetch_stop = True
+            self._fcond.notify_all()
+            ft = self._fetch_thread
+        if ft is not None:
+            ft.join(timeout_s)
+            if ft.is_alive():
+                logger.warning(
+                    "verify scheduler completion thread still alive %.1fs "
+                    "after close() — a wedged fetch will finish under "
+                    "whatever global state exists when it unwedges",
+                    timeout_s,
+                )
 
     # -- dispatcher -------------------------------------------------------
 
@@ -382,8 +451,14 @@ class VerifyScheduler:
                 b for b in ov._BUCKETS if self._full_target <= b <= scaled
             ]
             return fits[-1] if fits else self._full_target
-        except Exception:  # noqa: BLE001
-            return scaled
+        except Exception:  # noqa: BLE001 — clamp against the static
+            # bucket mirror: the raw scaled value may not be a bucket
+            fits = [
+                b
+                for b in _FALLBACK_BUCKETS
+                if self._full_target <= b <= scaled
+            ]
+            return fits[-1] if fits else self._full_target
 
     def _oldest_t0(self) -> Optional[float]:
         heads = [q[0].t0 for q in self._queues if q]
@@ -453,7 +528,15 @@ class VerifyScheduler:
     def _execute(self, items: "list[_Item]", reason: str) -> None:
         recorded = [False]
         try:
-            self._execute_inner(items, reason, recorded)
+            if pipeline_enabled():
+                # in-flight pipeline: dispatch without blocking on the
+                # verdicts — enqueueing onto the completion FIFO is the
+                # LAST step, so any exception reaching the fallback below
+                # means these items were never handed off and the host
+                # reference resolve covers all of them
+                self._dispatch_flush(items, reason, recorded)
+            else:
+                self._execute_inner(items, reason, recorded)
         except BaseException as e:  # noqa: BLE001 — futures must ALWAYS
             # resolve: these items left the queue, so the submit-path
             # dispatcher restart can never recover them — an unresolved
@@ -562,13 +645,7 @@ class VerifyScheduler:
         # end-of-run capture asserts queue_depth == 0) must not race the
         # dispatcher's bookkeeping; ``recorded`` keeps the _execute
         # fallback from double-counting if a resolve below raises
-        t_flush = items[0].t_drain
-        interval = (
-            None
-            if self._last_flush_t is None
-            else t_flush - self._last_flush_t
-        )
-        self._last_flush_t = t_flush
+        interval = self._flush_interval()
         stats.record_flush(
             reason, items=n, misses=len(firsts), lanes=lanes,
             interval_s=interval,
@@ -576,6 +653,229 @@ class VerifyScheduler:
         recorded[0] = True
         now = time.perf_counter()
         for i, it in enumerate(items):
+            it.future.set_result(bool(bits[i]))
+            stats.record_verdict(
+                it.prio,
+                now - it.t0,
+                queue_wait_s=it.t_drain - it.t0,
+                device_s=now - it.t_drain,
+            )
+
+    # -- in-flight pipeline (docs/verify-scheduler.md) --------------------
+
+    def _flush_interval(self) -> Optional[float]:
+        """Interval between consecutive flushes, stamped NOW on the
+        dispatcher thread — monotonic there by construction, so the
+        histogram cannot go negative or interleave however many flushes
+        are in flight."""
+        t = time.perf_counter()
+        interval = (
+            None if self._last_flush_t is None else t - self._last_flush_t
+        )
+        self._last_flush_t = t
+        return interval
+
+    def _dispatch_flush(
+        self, items: "list[_Item]", reason: str, recorded: "list[bool]"
+    ) -> None:
+        """The pipelined front half of a flush: structural filter +
+        dedup + ONE fused dispatch (``ops.verify.dispatch_segments``),
+        then hand the in-flight handle to the completion thread and
+        return to draining — up to ``inflight_target()`` flushes ride
+        the device concurrently, round-robined across healthy mesh
+        lanes.  Identical front-half semantics to ``_execute_inner``;
+        only WHERE the fetch happens moves."""
+        n = len(items)
+        pubs = [it.pub for it in items]
+        msgs = [it.msg for it in items]
+        sigs = [it.sig for it in items]
+        interval = self._flush_interval()
+
+        with tracing.span("sched.flush", reason=reason, items=n) as fsp:
+            bits: "list[Optional[bool]]" = [None] * n
+            uniq: "OrderedDict[bytes, list[int]]" = OrderedDict()
+            for i in range(n):
+                if len(pubs[i]) != 32 or len(sigs[i]) != 64:
+                    bits[i] = False
+                    continue
+                k = sigcache._key(pubs[i], msgs[i], sigs[i])
+                uniq.setdefault(k, []).append(i)
+            firsts = [ixs[0] for ixs in uniq.values()]
+            stats.record_dedup(sum(len(ixs) - 1 for ixs in uniq.values()))
+
+            lanes = 0
+            handle = None
+            ordered: "list[int]" = []
+            if firsts:
+                from cometbft_tpu.ops import verify as ov
+
+                by_class: "list[list[int]]" = [[] for _ in range(N_CLASSES)]
+                for i in firsts:
+                    by_class[items[i].prio].append(i)
+                ordered = [i for cls in by_class for i in cls]
+                work = [
+                    (
+                        [pubs[i] for i in cls],
+                        [msgs[i] for i in cls],
+                        [sigs[i] for i in cls],
+                    )
+                    for cls in by_class
+                    if cls
+                ]
+                lanes = ov.bucket_size(len(ordered), ov._min_bucket())
+                self._ensure_fetch_thread()
+                cap = max(inflight_target(), 1)
+                # reserve an in-flight slot BEFORE dispatching — the cap
+                # bounds concurrent dispatches, and the wait re-checks the
+                # completion thread so a dead one is restarted rather
+                # than waited on forever
+                with self._fcond:
+                    while self._inflight >= cap:
+                        if (
+                            self._fetch_thread is None
+                            or not self._fetch_thread.is_alive()
+                        ):
+                            break
+                        self._fcond.wait(0.1)
+                    self._inflight += 1
+                    stats.record_inflight(self._inflight)
+                self._ensure_fetch_thread()
+                lane = None
+                try:
+                    from cometbft_tpu.parallel import elastic
+
+                    # the probe-ADMITTING membership walk, not the
+                    # read-only healthy list: a half-open chip re-earns
+                    # its lane via the one-bucket probe here, exactly as
+                    # it would under a mesh-wide dispatch.  Below 2 lanes
+                    # the mesh rule says single-chip: lane=None falls
+                    # into the pallas→xla→host chain, which keeps THOSE
+                    # breakers probed and re-promoted too.
+                    ords = elastic.admit_ordinals()
+                    if len(ords) >= 2:
+                        lane = ords[self._lane_rr % len(ords)]
+                        self._lane_rr += 1
+                except Exception:  # noqa: BLE001 — lane pinning is an
+                    # optimization, never load-bearing
+                    lane = None
+                try:
+                    with tracing.span(
+                        "sched.dispatch", reason=reason, items=n,
+                        lanes=lanes,
+                    ):
+                        handle = ov.dispatch_segments(work, lane=lane)
+                except BaseException:
+                    with self._fcond:
+                        self._inflight -= 1
+                        stats.record_inflight(self._inflight)
+                        self._fcond.notify_all()
+                    raise
+            fsp.set(misses=len(firsts), lanes=lanes)
+
+        stats.record_flush(
+            reason, items=n, misses=len(firsts), lanes=lanes,
+            interval_s=interval,
+        )
+        recorded[0] = True
+        if handle is None:
+            # nothing device-bound (all garbage/empty): resolve inline
+            now = time.perf_counter()
+            for i, it in enumerate(items):
+                it.future.set_result(bool(bits[i]))
+                stats.record_verdict(
+                    it.prio,
+                    now - it.t0,
+                    queue_wait_s=it.t_drain - it.t0,
+                    device_s=now - it.t_drain,
+                )
+            return
+        with self._fcond:
+            self._fetch_queue.append((handle, items, bits, uniq, ordered))
+            self._fcond.notify_all()
+
+    def _ensure_fetch_thread(self) -> None:
+        """Start — or RESTART, mirroring the dispatcher's own restart
+        path — the completion thread.  A dead completion thread with
+        flushes still queued would strand their futures forever."""
+        with self._fcond:
+            if self._fetch_thread is None or not self._fetch_thread.is_alive():
+                if self._fetch_thread is not None:
+                    logger.error(
+                        "verify completion thread died; restarting "
+                        "(%d flushes in flight)",
+                        len(self._fetch_queue),
+                    )
+                self._fetch_thread = threading.Thread(
+                    target=self._fetch_run,
+                    name="verify-sched-fetch",
+                    daemon=True,
+                )
+                self._fetch_thread.start()
+
+    def _fetch_run(self) -> None:
+        while True:
+            with self._fcond:
+                while not self._fetch_queue and not self._fetch_stop:
+                    self._fcond.wait()
+                if not self._fetch_queue:
+                    return  # stop requested and FIFO drained
+                pf = self._fetch_queue.popleft()
+            try:
+                self._resolve_flush(pf)
+            finally:
+                with self._fcond:
+                    self._inflight = max(0, self._inflight - 1)
+                    stats.record_inflight(self._inflight)
+                    self._fcond.notify_all()
+
+    def _resolve_flush(self, pf: tuple) -> None:
+        """The completion half of one pipelined flush: fetch verdicts,
+        write the sigcache back, resolve every future.  Runs on the
+        completion thread in drain order; cannot leave a future
+        unresolved — a fetch that somehow escapes the supervisor's
+        degradation chain resolves the flush on the host reference."""
+        handle, items, bits, uniq, ordered = pf
+        try:
+            from cometbft_tpu.ops import verify as ov
+
+            with tracing.span("sched.fetch", items=len(items)):
+                results = ov.fetch_segments(handle)
+            verdict_by_first = dict(
+                zip(ordered, (bool(b) for seg in results for b in seg))
+            )
+            cache = sigcache.get_cache()
+            cache_on = cache.enabled()
+            for k, ixs in uniq.items():
+                v = verdict_by_first[ixs[0]]
+                for i in ixs:
+                    bits[i] = v
+                if cache_on:
+                    cache._put(k, v)
+        except BaseException:  # noqa: BLE001 — swallow even SystemExit:
+            # the completion thread must outlive one bad flush or every
+            # queued flush behind it strands its futures
+            logger.exception(
+                "pipelined flush fetch failed unexpectedly; resolving %d "
+                "items on the host reference",
+                len(items),
+            )
+            from cometbft_tpu.crypto import ed25519_ref as ref
+
+            for i, it in enumerate(items):
+                if it.future.done() or bits[i] is not None:
+                    continue
+                try:
+                    bits[i] = len(it.pub) == 32 and len(
+                        it.sig
+                    ) == 64 and bool(
+                        ref.verify_zip215(it.pub, it.msg, it.sig)
+                    )
+                except Exception:  # noqa: BLE001 — malformed input
+                    bits[i] = False
+        now = time.perf_counter()
+        for i, it in enumerate(items):
+            if it.future.done():
+                continue
             it.future.set_result(bool(bits[i]))
             stats.record_verdict(
                 it.prio,

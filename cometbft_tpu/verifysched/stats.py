@@ -72,6 +72,10 @@ def _zero() -> dict:
         "queue_wait_hist": {c: Histo() for c in CLASS_NAMES},
         "device_hist": {c: Histo() for c in CLASS_NAMES},
         "flush_interval_hist": Histo(),
+        # in-flight pipeline (docs/verify-scheduler.md): flushes currently
+        # dispatched but not yet fetched, and the high-water mark
+        "inflight_depth": 0,
+        "inflight_hwm": 0,
     }
 
 
@@ -113,6 +117,16 @@ def record_flush(
         _STATS["queue_depth"] = max(0, _STATS["queue_depth"] - int(items))
         if interval_s is not None:
             _STATS["flush_interval_hist"].observe(float(interval_s))
+
+
+def record_inflight(depth: int) -> None:
+    """Current number of dispatched-but-unfetched flushes — written by the
+    dispatcher at dispatch and by the completion pool at fetch, rendered
+    as the ``cometbft_sched_inflight_depth`` gauge."""
+    with _LOCK:
+        _STATS["inflight_depth"] = int(depth)
+        if depth > _STATS["inflight_hwm"]:
+            _STATS["inflight_hwm"] = int(depth)
 
 
 def record_dedup(n: int) -> None:

@@ -35,7 +35,17 @@ import pathlib
 import sys
 
 _SEAM_NAMES = frozenset(
-    ("verify_batch", "verify_segments", "verify_batches_overlapped")
+    (
+        "verify_batch",
+        "verify_segments",
+        "verify_batches_overlapped",
+        # in-flight pipeline halves + the chunked large-batch entry
+        # (docs/verify-scheduler.md "In-flight pipeline"): same rule —
+        # production code reaches them through verifysched, not directly
+        "dispatch_segments",
+        "fetch_segments",
+        "verify_pipelined",
+    )
 )
 
 ALLOWED_DIRS = (

@@ -21,28 +21,28 @@ cd "$(dirname "$0")/.."
 # gate run compiled, instead of re-tracing per process.
 export COMETBFT_TPU_EXEC_CACHE="${COMETBFT_TPU_EXEC_CACHE:-$PWD/.exec_cache}"
 
-echo "== gate 1/10: verify/hash call-site + disk-policy lints =="
+echo "== gate 1/11: verify/hash call-site + disk-policy lints =="
 python scripts/check_verify_callsites.py
 # new direct merkle call sites must use the proofserve plane seam
 python scripts/check_hash_callsites.py
 # new direct open/fsync/replace call sites must use the diskguard seam
 python scripts/check_diskpolicy.py
 
-echo "== gate 2/10: pytest =="
+echo "== gate 2/11: pytest =="
 rm -f /tmp/_gate_t1.log
 python -m pytest tests/ -x -q --durations=40 2>&1 | tee /tmp/_gate_t1.log
 python scripts/check_tier1_budget.py /tmp/_gate_t1.log
 
-echo "== gate 3/10: bench.py =="
+echo "== gate 3/11: bench.py =="
 python bench.py
 
-echo "== gate 4/10: bench.py --meshfault (elastic mesh fault isolation) =="
+echo "== gate 4/11: bench.py --meshfault (elastic mesh fault isolation) =="
 # healthy vs one-dead-chip dispatch on the per-shard host-oracle seam:
 # verdict equality, exactly one shrink, dispatch counts asserted hard;
 # refreshes BENCH_MESHFAULT.json for the trend gate below
 JAX_PLATFORMS=cpu python bench.py --meshfault
 
-echo "== gate 5/10: disk-fault robustness (diskguard) =="
+echo "== gate 5/11: disk-fault robustness (diskguard) =="
 # the three storage scenarios (fail-stop halt / degrade-with-retries /
 # torn-tail repair) with invariants raised to hard failures, then the
 # bench stage: verdict equality under injected faults + same-seed trace
@@ -57,7 +57,7 @@ for name in ('disk-full', 'disk-brownout', 'torn-wal-restart'):
 "
 JAX_PLATFORMS=cpu python bench.py --diskfault
 
-echo "== gate 6/10: proof plane (light-stampede + bench.py --proofserve) =="
+echo "== gate 6/11: proof plane (light-stampede + bench.py --proofserve) =="
 # thousands of light-client proof queries mid-consensus on the host
 # tree-runner seam: zero consensus-class verify shed, commits reach the
 # target, byte-deterministic per seed (invariants raised to hard
@@ -75,26 +75,35 @@ print('light-stampede ok heights=%s proofs=%s' % (r.heights, r.proofs))
 "
 JAX_PLATFORMS=cpu python bench.py --proofserve
 
-echo "== gate 7/10: bench trend (BENCH_HISTORY.jsonl) =="
+echo "== gate 7/11: bench.py --multichip (in-flight verify pipeline) =="
+# the 10240-sig commit shape chunked over an 8-lane virtual mesh with K
+# dispatches in flight on the host-oracle shard seam: oracle-equal
+# verdicts, full in-flight occupancy and lane coverage asserted hard
+# (skips itself when jax reports < 2 devices); refreshes
+# BENCH_MULTICHIP.json for the trend gate below
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python bench.py --multichip
+
+echo "== gate 8/11: bench trend (BENCH_HISTORY.jsonl) =="
 # re-ingests every BENCH_*.json + sim_soak trend JSON and fails on hard
 # regressions (dispatch counts, cache/occupancy ratios) beyond the noise
 # band; wall/throughput deltas stay advisory on this throttled host
 python scripts/bench_trend.py --check
 
-echo "== gate 8/10: SIGKILL forensics (black-box postmortem) =="
+echo "== gate 9/11: SIGKILL forensics (black-box postmortem) =="
 # crash a sim validator mid-round, decode its journal with the real
 # `cometbft-tpu postmortem --json` subprocess, assert the reconstructed
 # in-flight round + dispatch attribution, byte-deterministic per seed
 JAX_PLATFORMS=cpu python scripts/check_postmortem.py
 
-echo "== gate 9/10: dryrun_multichip(8) + elastic fault leg =="
+echo "== gate 10/11: dryrun_multichip(8) + elastic fault leg =="
 # includes the chip-death leg: one ordinal killed mid-run, the batch
 # must re-verify on the shrunken mesh with correct ordinal attribution
 # (COMETBFT_TPU_DRYRUN_FAULT=0 skips the leg)
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== gate 10/10: native sanitizers (TSAN+ASAN) =="
+echo "== gate 11/11: native sanitizers (TSAN+ASAN) =="
 bash scripts/sanitize_native.sh
 
 if [ "${NIGHTLY:-0}" = "1" ]; then

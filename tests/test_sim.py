@@ -429,6 +429,33 @@ class TestBackendFaultScenarios:
         assert "sched.flush" in res.spans["stages"], res.spans["stages"]
         assert self._snapshot_globals() == before
 
+    def test_pipeline_burst_overlaps_in_flight(self, tmp_path):
+        """In-flight verify pipeline (docs/verify-scheduler.md): with the
+        completion pool gated mid-burst, the dispatcher must ship a
+        second fused flush while the first is still in flight — and every
+        future still resolves with the definitive verdict, consensus
+        untouched."""
+        before = self._snapshot_globals()
+        res = run_scenario(
+            "pipeline-burst", 3, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"heights {res.heights}"
+        assert not res.violations
+        s = res.sched
+        assert s["inflight_hwm"] >= 2, s  # two flushes genuinely overlapped
+        assert s["inflight_depth"] == 0, s  # every dispatch was fetched
+        assert s["shed"]["consensus"] == 0, s
+        assert s["submitted"]["consensus"] > 0, s  # votes rode the scheduler
+        assert s["queue_depth"] == 0, s  # nothing left hanging
+        assert sum(s["flushes"].values()) > 0, s
+        # the pipelined path keeps the flush span and adds the halves
+        assert "sched.flush" in res.spans["stages"], res.spans["stages"]
+        assert "sched.dispatch" in res.spans["stages"], res.spans["stages"]
+        assert "sched.fetch" in res.spans["stages"], res.spans["stages"]
+        burst_lines = [l for l in res.trace if "pipelined burst" in l]
+        assert len(burst_lines) == 2, burst_lines
+        assert self._snapshot_globals() == before
+
     def test_tx_flood_batched_admission(self, tmp_path):
         """Batched tx ingestion under flood (ISSUE 6, docs/tx-ingest.md):
         scripted bursts of valid/forged/malformed/oversize/duplicate
@@ -564,6 +591,20 @@ class TestBackendFaultScenarios:
             b.spans["dumps"],
         )
         assert any("queue_shed" in d["file"] for d in a.spans["dumps"])
+
+    @pytest.mark.slow
+    def test_pipeline_burst_deterministic(self, tmp_path):
+        """Same seed => byte-identical traces with the completion pool in
+        the loop: each burst action blocks on every future before logging,
+        so nothing in the trace can depend on dispatch/fetch interleaving.
+        (Slow lane: doubles a whole scenario run — the PR-1/PR-3
+        precedent.)"""
+        a = run_scenario("pipeline-burst", 17, root=tmp_path / "a")
+        b = run_scenario("pipeline-burst", 17, root=tmp_path / "b")
+        assert a.trace == b.trace
+        assert a.heights == b.heights
+        assert a.sched["shed"] == b.sched["shed"]
+        assert a.sched["verdicts"] == b.sched["verdicts"]
 
     @pytest.mark.slow
     def test_backend_brownout_deterministic(self, tmp_path):

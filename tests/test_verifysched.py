@@ -11,6 +11,7 @@ One smoke test exercises a single real dispatch through the full stack.
 
 import hashlib
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -207,19 +208,24 @@ class TestSchedulerCore:
         sched = VerifyScheduler(flush_us=500)
         try:
             pubs, msgs, sigs = _make_sigs(1, b"dead")
-            orig = sched._execute_inner
+            orig_inner = sched._execute_inner
+            orig_disp = sched._dispatch_flush
 
             def dying(items, reason, recorded):
                 raise SystemExit  # BaseException: kills the thread
 
+            # both flush paths (pipelined and single-flight) must feed the
+            # same host-fallback-then-die contract
             sched._execute_inner = dying
+            sched._dispatch_flush = dying
             f1 = sched.submit(pubs[0], msgs[0], sigs[0])
             # already-drained future still resolves (host fallback)...
             assert f1.result(timeout=30) is True
             t = sched._thread
             t.join(10)
             assert not t.is_alive()  # ...and THEN the thread died
-            sched._execute_inner = orig
+            sched._execute_inner = orig_inner
+            sched._dispatch_flush = orig_disp
             p2, m2, s2 = _make_sigs(1, b"alive")
             f2 = sched.submit(p2[0], m2[0], s2[0])
             assert f2.result(timeout=30) is True
@@ -525,6 +531,216 @@ class TestSupervisorIntegration:
 
 
 # ----------------------------------------------------------------------
+# in-flight pipeline (docs/verify-scheduler.md "In-flight pipeline")
+# ----------------------------------------------------------------------
+
+
+class TestInflightPipeline:
+    WIDTH = 3
+
+    @pytest.fixture
+    def lane_mesh(self, sched_env, monkeypatch):
+        """sched_env + a 3-ordinal virtual elastic mesh on the host-oracle
+        mesh runner, so pipelined flushes round-robin across real lane
+        handles (``elastic.dispatch_lane``/``fetch_lane``)."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.ops import device_health
+        from cometbft_tpu.parallel import elastic
+
+        monkeypatch.setenv("COMETBFT_TPU_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("COMETBFT_TPU_SCHED_INFLIGHT", str(self.WIDTH))
+        backend_health.reset()
+        device_health.reset()
+        elastic.clear()
+        elastic.configure(range(self.WIDTH))
+        elastic.set_mesh_runner(elastic.host_oracle_runner)
+        yield elastic
+        elastic.clear_fault_injector()
+        elastic.clear_mesh_runner()
+        elastic.clear()
+        device_health.reset()
+        backend_health.reset()
+
+    def test_differential_pipelined_vs_single_flight(
+        self, sched_env, monkeypatch
+    ):
+        """K-in-flight verdicts bitwise-equal to single-flight on a
+        randomized valid/invalid mix including structural garbage — the
+        acceptance property for ``COMETBFT_TPU_SCHED_PIPELINE``."""
+        pubs, msgs, sigs = _make_sigs(96, b"pipe-mix", invalid_every=3)
+        pubs[7], sigs[13] = b"\x01" * 30, b"\x02" * 60
+
+        monkeypatch.setenv("COMETBFT_TPU_SCHED_PIPELINE", "0")
+        sched = VerifyScheduler(flush_us=500)
+        try:
+            futs = sched.submit_many(pubs, msgs, sigs)
+            single = [f.result(timeout=60) for f in futs]
+        finally:
+            sched.close()
+        assert single == _oracle(pubs, msgs, sigs)
+
+        sigcache.reset_cache()  # the first run must not seed the second
+        monkeypatch.setenv("COMETBFT_TPU_SCHED_PIPELINE", "1")
+        monkeypatch.setenv("COMETBFT_TPU_SCHED_INFLIGHT", "3")
+        sched = VerifyScheduler(flush_us=500)
+        try:
+            futs = sched.submit_many(pubs, msgs, sigs)
+            piped = [f.result(timeout=60) for f in futs]
+        finally:
+            sched.close()
+        assert piped == single
+
+    def test_dispatch_overlap_inflight_high_water(
+        self, sched_env, monkeypatch
+    ):
+        """With the completion pool parked on a gate, the dispatcher keeps
+        shipping: the in-flight high-water mark proves two flushes
+        genuinely overlapped instead of serializing."""
+        monkeypatch.setenv("COMETBFT_TPU_SCHED_INFLIGHT", "2")
+        gate = threading.Event()
+
+        def slow_runner(backend, pubs, msgs, sigs, lanes):
+            gate.wait(20)
+            return _oracle_runner(backend, pubs, msgs, sigs, lanes)
+
+        supervisor.set_device_runner(slow_runner)
+        sched = VerifyScheduler(flush_us=500)
+        try:
+            a = _make_sigs(4, b"ovl-a")
+            b = _make_sigs(4, b"ovl-b")
+            futs = sched.submit_many(*a)
+            deadline = time.perf_counter() + 10
+            # flush A dispatched, its fetch parked on the gate...
+            while dispatch_stats.snapshot()["inflight_depth"] < 1:
+                assert time.perf_counter() < deadline
+                threading.Event().wait(0.005)
+            # ...and flush B ships right behind it
+            futs += sched.submit_many(*b)
+            while dispatch_stats.snapshot()["inflight_depth"] < 2:
+                assert time.perf_counter() < deadline
+                threading.Event().wait(0.005)
+            gate.set()
+            assert all(f.result(timeout=30) is True for f in futs)
+        finally:
+            gate.set()
+            sched.close()
+        snap = dispatch_stats.snapshot()
+        assert snap["inflight_hwm"] >= 2
+        assert snap["inflight_depth"] == 0  # every dispatch was fetched
+        assert sstats.snapshot()["inflight_hwm"] >= 2
+
+    def test_single_lane_fault_degrades_that_lane_only(self, lane_mesh):
+        """FaultyDevice raise on ONE mesh lane mid-pipeline: the other
+        lanes' flushes complete untouched, the guilty lane's breaker
+        trips and the mesh shrinks by one, and every future still
+        resolves with the oracle verdict."""
+        from cometbft_tpu.crypto import backend_health
+
+        elastic = lane_mesh
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("raise", ordinals=(1,))
+        )
+        pubs, msgs, sigs = _make_sigs(18, b"lane-flt", invalid_every=5)
+        sched = VerifyScheduler(flush_us=300)
+        try:
+            futs = []
+            # one paused round per lane: three flushes round-robin over
+            # the three ordinals, so exactly one rides the faulty lane
+            for r in range(self.WIDTH):
+                sched.pause()
+                lo, hi = r * 6, (r + 1) * 6
+                futs += sched.submit_many(
+                    pubs[lo:hi], msgs[lo:hi], sigs[lo:hi]
+                )
+                sched.resume()
+                assert all(
+                    f.result(timeout=60) is not None for f in futs[lo:hi]
+                )
+            got = [f.result(timeout=60) for f in futs]
+        finally:
+            sched.close()
+        assert got == _oracle(pubs, msgs, sigs)
+        reg = backend_health.registry()
+        assert reg.breaker("mesh_dev1").stats()["failures_total"] >= 1
+        assert reg.breaker("mesh_dev0").stats()["failures_total"] == 0
+        assert reg.breaker("mesh_dev2").stats()["failures_total"] == 0
+        snap = dispatch_stats.snapshot()
+        assert snap["mesh_shrinks"] == 1
+        assert snap["lane_dispatches"].get("1", 0) >= 1  # it WAS routed
+
+    def test_single_lane_hang_wedges_alone(self, lane_mesh, monkeypatch):
+        """FaultyDevice hang on one lane: the shard watchdog abandons it
+        (shard_watchdog_fire), the wedged lane alone degrades, and every
+        future resolves — nobody waits on the hung fetch."""
+        from cometbft_tpu.crypto import backend_health
+        from cometbft_tpu.libs import tracing
+
+        monkeypatch.setenv("COMETBFT_TPU_DISPATCH_TIMEOUT_MS", "100")
+        tracing.reset_tracer()
+        elastic = lane_mesh
+        elastic.set_fault_injector(
+            elastic.FaultyDevice("hang", ordinals=(1,), hang_s=2.0)
+        )
+        pubs, msgs, sigs = _make_sigs(12, b"lane-hang", invalid_every=4)
+        sched = VerifyScheduler(flush_us=300)
+        try:
+            futs = []
+            for r in range(self.WIDTH):
+                sched.pause()
+                lo, hi = r * 4, (r + 1) * 4
+                futs += sched.submit_many(
+                    pubs[lo:hi], msgs[lo:hi], sigs[lo:hi]
+                )
+                sched.resume()
+                assert all(
+                    f.result(timeout=60) is not None for f in futs[lo:hi]
+                )
+            got = [f.result(timeout=60) for f in futs]
+        finally:
+            sched.close()
+        assert got == _oracle(pubs, msgs, sigs)
+        reg = backend_health.registry()
+        assert reg.breaker("mesh_dev1").stats()["failures_total"] >= 1
+        assert reg.breaker("mesh_dev0").stats()["failures_total"] == 0
+        assert reg.breaker("mesh_dev2").stats()["failures_total"] == 0
+        snap = tracing.get_tracer().snapshot()
+        assert snap["anomalies"].get("shard_watchdog_fire", 0) >= 1
+
+    def test_pipeline_kill_switch_single_flight(self, sched_env, monkeypatch):
+        """``COMETBFT_TPU_SCHED_PIPELINE=0`` restores single-flight
+        bit-for-bit: no completion pool, no in-flight accounting, same
+        verdicts."""
+        monkeypatch.setenv("COMETBFT_TPU_SCHED_PIPELINE", "0")
+        pubs, msgs, sigs = _make_sigs(12, b"pipe-off", invalid_every=4)
+        sched = VerifyScheduler(flush_us=500)
+        try:
+            futs = sched.submit_many(pubs, msgs, sigs)
+            got = [f.result(timeout=30) for f in futs]
+        finally:
+            sched.close()
+        assert got == _oracle(pubs, msgs, sigs)
+        assert sched._fetch_thread is None  # never instantiated
+        assert dispatch_stats.snapshot()["inflight_hwm"] == 0
+        assert sstats.snapshot()["inflight_hwm"] == 0
+
+    def test_bucket_target_fallback_clamps_to_bucket(
+        self, sched_env, monkeypatch
+    ):
+        """The _bucket_target exception fallback must return a REAL
+        padding bucket, not the raw width-scaled value (32 x 3 = 96 is
+        not a bucket; the largest bucket <= 96 is 64)."""
+        import cometbft_tpu.ops as ops_pkg
+        from cometbft_tpu.parallel import elastic
+
+        sched = VerifyScheduler()
+        sched._full_target = 32  # base bucket already resolved
+        monkeypatch.setattr(elastic, "healthy_width", lambda: 3)
+        monkeypatch.setattr(ops_pkg, "verify", None)  # ops seam broken
+        assert sched._bucket_target() == 64
+        sched.close()
+
+
+# ----------------------------------------------------------------------
 # metrics / tooling
 # ----------------------------------------------------------------------
 
@@ -544,6 +760,11 @@ class TestMetricsAndTooling:
         assert "cometbft_sched_verdicts 3" in out
         for reason in ("deadline", "full", "shutdown"):
             assert 'cometbft_sched_flushes{reason="%s"}' % reason in out
+        # in-flight pipeline: everything resolved, so depth is back to 0
+        # but the flush above rode the pipeline and left per-lane tallies
+        assert "cometbft_sched_inflight_depth 0" in out
+        assert "cometbft_sched_inflight_hwm 1" in out
+        assert 'cometbft_crypto_lane_occupancy{lane="' in out
 
     def test_callsite_lint_clean(self):
         """The CI lint (tier-1-wired): no direct verify_batch/
