@@ -728,11 +728,12 @@ def _warmboot_boot(cache_dir: str, jax_cache: str, buckets: str,
         JAX_COMPILATION_CACHE_DIR=jax_cache,
         COMETBFT_TPU_WARMBOOT="1",
         COMETBFT_TPU_WARMBOOT_BUCKETS=buckets,
-        # ed25519 matrix only: the secp/BLS families would add ~30s
-        # compiles per shape on this host and are not what this stage
-        # times (their warm pass is covered by test_warmboot)
+        # ed25519 matrix only: the secp/BLS/transport families would add
+        # ~30s compiles per shape on this host and are not what this
+        # stage times (their warm pass is covered by test_warmboot)
         COMETBFT_TPU_WARMBOOT_SECP_BUCKETS="",
         COMETBFT_TPU_WARMBOOT_BLS_BUCKETS="",
+        COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS="",
         COMETBFT_TPU_SUPERVISOR="0",  # measure the pipeline, not the
         # watchdog: a >120s cold compile must not demote mid-measurement
         BENCH_T0=repr(time.time()),
@@ -1423,6 +1424,171 @@ def run_proofserve(
         f"{coalesced_per_1k} >= {serial_per_1k} dispatches/1k proofs"
     )
     out = os.path.join(REPO, "BENCH_PROOFSERVE.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return rec
+
+
+def run_transport(emit, n_frames=2000, frame_bytes=1024, n_dials=1000) -> dict:
+    """Encrypted transport data plane stage (docs/transport-plane.md).
+    Two legs, both on the host runner seams so the stage is jax-free,
+    deterministic, and platform-independent:
+
+      * **AEAD leg** — ``n_frames`` fixed-size frames sealed through
+        ``transportplane.seal_frames`` in write_msg-sized bursts (ONE
+        counted dispatch per burst) vs the pre-plane way (one
+        ``ChaCha20Poly1305Ref.encrypt`` per frame), then the whole
+        stream re-opened through the plane;
+      * **handshake leg** — ``n_dials`` concurrent dials through a
+        paused/resumed ``HandshakePool`` (max_batch-sized ladder
+        dispatches) vs one ``sync_exchange`` per dial.
+
+    Asserted hard: ciphertexts||tags and shared secrets bitwise-equal
+    between the legs, and coalesced dispatches-per-1k strictly below
+    serial (which is 1000 by construction) on both legs.  Walls (MB/s,
+    handshakes/s) are advisory.  Emitted as stage="transport" and
+    written to BENCH_TRANSPORT.json for the bench_trend gate."""
+    import hashlib
+
+    from cometbft_tpu.crypto import aead_ref
+    from cometbft_tpu.ops import chacha_aead, x25519_ladder
+    from cometbft_tpu.p2p import handshake_pool, transportplane
+    from cometbft_tpu.p2p import transport_stats as tpstats
+
+    key = hashlib.sha256(b"bench-transport-key").digest()
+    payloads = []
+    for i in range(n_frames):
+        block = hashlib.sha256(b"bench-transport-frame-%d" % i).digest()
+        payloads.append((block * ((frame_bytes + 31) // 32))[:frame_bytes])
+
+    # -- AEAD leg ---------------------------------------------------------
+    aead_dispatches = 0
+
+    def counting_aead_runner(op, frames):
+        nonlocal aead_dispatches
+        aead_dispatches += 1
+        return chacha_aead.host_aead_runner(op, frames)
+
+    burst = int(os.environ.get("BENCH_TRANSPORT_BURST", "64"))
+    chacha_aead.set_aead_runner(counting_aead_runner)
+    tpstats.reset()
+    sealed_coalesced: "list[bytes]" = []
+    try:
+        t0 = time.perf_counter()
+        for start in range(0, n_frames, burst):
+            sealed_coalesced.extend(
+                transportplane.seal_frames(
+                    key, start, payloads[start : start + burst]
+                )
+            )
+        coalesced_wall = time.perf_counter() - t0
+        snap = tpstats.snapshot()
+    finally:
+        chacha_aead.clear_aead_runner()
+
+    cipher = aead_ref.ChaCha20Poly1305Ref(key)
+    t0 = time.perf_counter()
+    sealed_serial = [
+        cipher.encrypt(transportplane.nonce_bytes(i), payloads[i], b"")
+        for i in range(n_frames)
+    ]
+    serial_wall = time.perf_counter() - t0
+    assert sealed_serial == sealed_coalesced, (
+        "coalesced AEAD diverged from the serial reference"
+    )
+
+    # full-stream re-open through the plane (numpy tier, uncounted):
+    # every frame must authenticate and decrypt back to its payload
+    for start in range(0, n_frames, burst):
+        pts, bad = transportplane.open_frames(
+            key, start, sealed_coalesced[start : start + burst]
+        )
+        assert bad is None and pts == payloads[start : start + burst], (
+            f"plane open diverged in burst at {start}"
+        )
+
+    # -- handshake leg ----------------------------------------------------
+    ladder_dispatches = 0
+
+    def counting_ladder_runner(pairs):
+        nonlocal ladder_dispatches
+        ladder_dispatches += 1
+        return x25519_ladder.host_ladder_runner(pairs)
+
+    peer_pubs = [
+        aead_ref.x25519(
+            hashlib.sha256(b"bench-transport-peer-%d" % j).digest(),
+            x25519_ladder.BASE_U,
+        )
+        for j in range(8)
+    ]
+    pairs = [
+        (
+            hashlib.sha256(b"bench-transport-dial-%d" % i).digest(),
+            peer_pubs[i % len(peer_pubs)],
+        )
+        for i in range(n_dials)
+    ]
+
+    x25519_ladder.set_ladder_runner(counting_ladder_runner)
+    pool = handshake_pool.HandshakePool(
+        flush_us=2000.0, queue_cap=n_dials, max_batch=256
+    )
+    try:
+        t0 = time.perf_counter()
+        pool.pause()
+        futs = [pool.submit(s, p) for s, p in pairs]
+        pool.resume()
+        pooled = [f.result(timeout=120) for f in futs]
+        pool_wall = time.perf_counter() - t0
+    finally:
+        pool.close()
+        x25519_ladder.clear_ladder_runner()
+
+    t0 = time.perf_counter()
+    serial_secrets = [handshake_pool.sync_exchange(s, p) for s, p in pairs]
+    serial_hs_wall = time.perf_counter() - t0
+    assert pooled == serial_secrets, (
+        "pooled X25519 diverged from the serial reference"
+    )
+
+    mb = n_frames * frame_bytes / 1e6
+    frames_per_1k = 1000.0 * aead_dispatches / n_frames
+    dials_per_1k = 1000.0 * ladder_dispatches / n_dials
+    rec = {
+        "metric": "transport_plane",
+        "stage": "transport",
+        "frames": n_frames,
+        "frame_bytes": frame_bytes,
+        "aead_dispatches": aead_dispatches,
+        "frames_per_batch": round(snap["frames_per_batch"], 2),
+        "dispatches_per_1k_frames_coalesced": round(frames_per_1k, 3),
+        "dispatches_per_1k_frames_serial": 1000.0,
+        "coalesced_mb_per_s_advisory": round(mb / coalesced_wall, 2),
+        "serial_mb_per_s_advisory": round(mb / serial_wall, 2),
+        "dials": n_dials,
+        "ladder_dispatches": ladder_dispatches,
+        "dispatches_per_1k_dials_coalesced": round(dials_per_1k, 3),
+        "dispatches_per_1k_dials_serial": 1000.0,
+        "pooled_handshakes_per_s_advisory": round(n_dials / pool_wall, 1),
+        "serial_handshakes_per_s_advisory": round(
+            n_dials / serial_hs_wall, 1
+        ),
+    }
+    emit(rec)
+    assert frames_per_1k < 1000.0, (
+        "coalesced AEAD must beat per-frame serial sealing: "
+        f"{frames_per_1k} >= 1000 dispatches/1k frames"
+    )
+    assert dials_per_1k < 1000.0, (
+        "pooled handshakes must beat per-dial serial exchange: "
+        f"{dials_per_1k} >= 1000 dispatches/1k dials"
+    )
+    out = os.path.join(REPO, "BENCH_TRANSPORT.json")
     try:
         with open(out, "w") as f:
             json.dump(rec, f, indent=2, sort_keys=True)
@@ -2446,6 +2612,19 @@ def main() -> None:
         "the run",
     )
     ap.add_argument(
+        "--transport",
+        action="store_true",
+        help="run only the encrypted-transport-plane stage: coalesced "
+        "AEAD frame sealing (transportplane bursts, one counted "
+        "dispatch per burst) vs per-frame ChaCha20Poly1305Ref, and "
+        "pooled X25519 handshake admission vs per-dial sync exchange, "
+        "both on the host runner seams — ciphertexts/secrets "
+        "bitwise-equal and dispatches-per-1k asserted hard, MB/s and "
+        "handshakes/s advisory; writes BENCH_TRANSPORT.json for the "
+        "bench_trend gate; BENCH_TRANSPORT_FRAMES / _FRAME_B / _DIALS "
+        "/ _BURST size the run",
+    )
+    ap.add_argument(
         "--diskfault",
         action="store_true",
         help="run only the disk-fault supervisor stage: verify verdicts "
@@ -2565,6 +2744,15 @@ def main() -> None:
             n_heights=int(os.environ.get("BENCH_PROOFSERVE_HEIGHTS", "32")),
             txs_per_block=int(os.environ.get("BENCH_PROOFSERVE_TXS", "64")),
             sample=int(os.environ.get("BENCH_PROOFSERVE_SAMPLE", "2000")),
+        )
+    elif args.transport:
+        # jax-free by construction (host AEAD/ladder runner seams): no
+        # compilation cache plumbing needed
+        run_transport(
+            _emit,
+            n_frames=int(os.environ.get("BENCH_TRANSPORT_FRAMES", "2000")),
+            frame_bytes=int(os.environ.get("BENCH_TRANSPORT_FRAME_B", "1024")),
+            n_dials=int(os.environ.get("BENCH_TRANSPORT_DIALS", "1000")),
         )
     elif args.diskfault:
         run_diskfault(

@@ -723,10 +723,14 @@ class Node(BaseService):
         if self.switch is not None:
             self.switch.stop()
         from cometbft_tpu import proofserve
+        from cometbft_tpu.p2p import handshake_pool
 
         # drain the proof coalescer before servers close: a future handed
-        # to an RPC thread must resolve even across shutdown
+        # to an RPC thread must resolve even across shutdown; same for
+        # the handshake pool — a dial mid-flush must get its secret (or
+        # shed to sync) before the process tears down transport state
         proofserve.reset_server()
+        handshake_pool.reset_pool()
         if self.tx_ingest is not None:
             # drain queued gossip into the mempool before the proxy closes
             self.tx_ingest.close()

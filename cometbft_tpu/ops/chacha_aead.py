@@ -1,0 +1,671 @@
+"""Vectorized multi-frame ChaCha20-Poly1305 (RFC 8439) AEAD kernel.
+
+``crypto/aead_ref.py`` is per-frame host Python: fine for the handshake,
+hopeless for gossip-storm transport bandwidth (ROADMAP item 4).  This
+module seals/opens a whole batch of pending frames in one bucket-padded
+device pass — the SHA-256 tree machinery of ``ops/sha256_tree.py``
+applied to the transport AEAD:
+
+  * the host packs N frames (32-byte key, 96-bit nonce, payload) into
+    ``(blocks, lanes, 16)`` little-endian uint32 word tensors plus a
+    per-lane byte length; one executable per (lanes, blocks) bucket
+    serves any mix of frame lengths and *keys* (every lane carries its
+    own key/nonce — both directions of many connections fuse into one
+    dispatch);
+  * the kernel runs the 20-round ChaCha block function across all lanes
+    and all counter blocks at once (block 0 per lane yields the Poly1305
+    one-time key, blocks 1.. the keystream), masks the XOR output to the
+    per-lane length, and computes Poly1305 lane-parallel in 10x13-bit
+    limbs (the ``ops/fe25519`` limb discipline scaled down to 2^130-5:
+    uint32 columns, static bound analysis, parallel carries);
+  * the transport path always has EMPTY AAD (SecretConnection frames),
+    so the MAC input is exactly the zero-padded ciphertext words plus
+    one length block — no host-side MAC assembly at all.  Frames with
+    AAD belong to the host tiers.
+
+Supervision (docs/transport-plane.md):
+
+  * executables ride ``ops/aot_cache`` (tags ``chacha-{lanes}x{blocks}-
+    seal`` / ``-open``) and the warm-boot ``transport`` family
+    (``COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS``);
+  * the ``aead_device`` breaker + host tiers make degradation
+    supervised: an infra fault re-encrypts/re-verifies on the tier
+    below (packed-numpy ``aead_ref``, then pure scalar Python) — it can
+    cost latency, NEVER a wrong tag verdict.  A device-tier tag
+    mismatch is re-verified on the pure reference tier before the
+    reject is allowed out, so a corrupted device cannot reject a valid
+    frame (a mismatch there records a breaker failure instead);
+  * ``set_aead_runner`` is the host-oracle seam the sim scenarios and
+    the transport bench drive (mirrors ``sha256_tree.set_tree_runner``);
+  * jax-free at import time — the kernel path imports jax lazily, so a
+    /metrics scrape or a CPU-only node never initializes a backend.
+
+``COMETBFT_TPU_AEAD_DEVICE=0`` pins every frame to the host tiers;
+``COMETBFT_TPU_AEAD=0`` (checked by ``p2p/transportplane``) removes the
+plane entirely and restores the serial pure-Python path bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+import numpy as np
+
+from cometbft_tpu.crypto import aead_ref
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.p2p import transport_stats as tstats
+
+BREAKER = "aead_device"
+TAG_LEN = 16
+
+# lane buckets are powers of two; blocks buckets bound the frame length.
+# SecretConnection frames carry at most DATA_MAX_SIZE (1024) bytes of
+# plaintext = 16 blocks; 32 leaves slack for other callers.
+_MIN_LANES = 8
+_MAX_LANES_DEFAULT = 1024
+_MAX_BLOCKS = 32  # 2 KiB frames — bigger goes to the host tiers
+_MAX_BATCH_BYTES = 1 << 22  # lanes*blocks*64 budget: cap host pack + HBM
+
+_CHACHA_CONST = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+_QROUNDS = (
+    (0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14), (3, 7, 11, 15),
+    (0, 5, 10, 15), (1, 6, 11, 12), (2, 7, 8, 13), (3, 4, 9, 14),
+)
+
+# Poly1305 limb layout: 2^130-5 as 10 little-endian limbs of 13 bits.
+_PBITS = 13
+_PMASK = (1 << _PBITS) - 1
+_PLIMBS = 10
+
+
+def enabled() -> bool:
+    """COMETBFT_TPU_AEAD_DEVICE=0 pins every frame to the host tiers."""
+    return os.environ.get("COMETBFT_TPU_AEAD_DEVICE", "1") != "0"
+
+
+def _backend_trusted() -> bool:
+    """Same gate as ``verifysched.backend_trusted``: device AEAD passes
+    only when the trusted ``tpu`` batch seam is active, and NEVER
+    auto-probe (that would initialize jax from a socket write)."""
+    from cometbft_tpu.crypto import batch as cbatch
+
+    env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
+    if env and env != "auto":
+        return env == "tpu"
+    return cbatch._DEFAULT_BACKEND == "tpu"
+
+
+# -- host-oracle runner seam --------------------------------------------------
+
+_RUNNER_LOCK = threading.Lock()
+_AEAD_RUNNER: "list" = [None]
+
+
+def set_aead_runner(fn) -> None:
+    """Install a stand-in for the device AEAD pass: ``fn(op, frames) ->
+    [(out_bytes, tag_bytes)]`` with ``op`` in ("seal", "open") and
+    ``frames`` a list of (key, nonce, data) tuples.  The sim scenarios
+    and the transport bench pin the host oracle here so the
+    breaker/fallback machinery above the seam runs deterministically on
+    a CPU host — mirroring ``sha256_tree.set_tree_runner``."""
+    with _RUNNER_LOCK:
+        _AEAD_RUNNER[0] = fn
+
+
+def clear_aead_runner() -> None:
+    with _RUNNER_LOCK:
+        _AEAD_RUNNER[0] = None
+
+
+def aead_runner():
+    with _RUNNER_LOCK:
+        return _AEAD_RUNNER[0]
+
+
+def host_aead_runner(op, frames):
+    """The host ZIP of the AEAD kernel — verdict-identical by
+    construction (it IS the kernel's differential oracle)."""
+    return _host_pass(op, frames, pure=False)
+
+
+def device_active() -> bool:
+    """True when AEAD passes should attempt the device path: an injected
+    runner always qualifies; otherwise the kill switch AND the trusted
+    batch backend gate (jax-free check)."""
+    if aead_runner() is not None:
+        return enabled()
+    return enabled() and _backend_trusted()
+
+
+# -- host tiers ---------------------------------------------------------------
+
+
+def _host_pass(op, frames, pure: bool):
+    """Per-frame reference computation, shared by the packed-numpy tier
+    (``pure=False``: bigint lane-packed ChaCha) and the pure scalar tier
+    (``pure=True``).  The Poly1305 half is the reference bigint path in
+    both tiers; only the ChaCha XOR differs.  Returns [(out, tag)] with
+    ``out`` the ciphertext (seal) or candidate plaintext (open) and
+    ``tag`` the MAC computed over the ciphertext — byte-identical to
+    ``ChaCha20Poly1305Ref`` with empty AAD on every input."""
+    xor = (
+        aead_ref._chacha20_xor_scalar if pure else aead_ref._chacha20_xor
+    )
+    outs = []
+    for key, nonce, data in frames:
+        out = xor(key, 1, nonce, data)
+        mac_src = out if op == "seal" else data
+        otk = aead_ref._chacha20_block(key, 0, nonce)[:32]
+        mac = aead_ref._poly1305(
+            otk,
+            mac_src
+            + aead_ref._pad16(mac_src)
+            + struct.pack("<QQ", 0, len(mac_src)),
+        )
+        outs.append((out, mac))
+    return outs
+
+
+# -- device kernel ------------------------------------------------------------
+
+
+def _rotl(x, n: int):
+    import jax.numpy as jnp
+
+    return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
+
+
+def _chacha_blocks(key_rows, nonce_rows, counter):
+    """20-round ChaCha block function over a (X, lanes) counter grid.
+    ``key_rows``/``nonce_rows`` are per-lane word lists broadcast over
+    the block axis.  Returns the 16 output words, each (X, lanes)
+    uint32 — uint32 arithmetic wraps in XLA exactly as the spec
+    requires."""
+    import jax.numpy as jnp
+
+    shape = counter.shape
+    st = [jnp.full(shape, c, jnp.uint32) for c in _CHACHA_CONST]
+    st += [jnp.broadcast_to(k[None, :], shape) for k in key_rows]
+    st.append(counter)
+    st += [jnp.broadcast_to(nc[None, :], shape) for nc in nonce_rows]
+    w = list(st)
+    for _ in range(10):
+        for a, b, c, d in _QROUNDS:
+            wa, wb, wc, wd = w[a], w[b], w[c], w[d]
+            wa = wa + wb
+            wd = _rotl(wd ^ wa, 16)
+            wc = wc + wd
+            wb = _rotl(wb ^ wc, 12)
+            wa = wa + wb
+            wd = _rotl(wd ^ wa, 8)
+            wc = wc + wd
+            wb = _rotl(wb ^ wc, 7)
+            w[a], w[b], w[c], w[d] = wa, wb, wc, wd
+    return [x + y for x, y in zip(w, st)]
+
+
+def _limbs_of_words(words4, lanes_shape=None):
+    """4 little-endian uint32 words -> 10 limbs of 13 bits (lists of
+    arrays; static python loop, no gathers)."""
+    limbs = []
+    for j in range(_PLIMBS):
+        b = _PBITS * j
+        k, off = b // 32, b % 32
+        w = words4[k] >> off
+        if off + _PBITS > 32 and k + 1 < 4:
+            w = w | (words4[k + 1] << (32 - off))
+        limbs.append(w & _PMASK)
+    return limbs
+
+
+def _words_of_limbs(limbs):
+    """10 canonical 13-bit limbs -> 4 little-endian uint32 words (the
+    value mod 2^128; bits 128..129 drop off the top shift)."""
+    l = limbs
+    w0 = l[0] | (l[1] << 13) | (l[2] << 26)
+    w1 = (l[2] >> 6) | (l[3] << 7) | (l[4] << 20)
+    w2 = (l[4] >> 12) | (l[5] << 1) | (l[6] << 14) | (l[7] << 27)
+    w3 = (l[7] >> 5) | (l[8] << 8) | (l[9] << 21)
+    return [w0, w1, w2, w3]
+
+
+def _poly_mulmod(t, r):
+    """(acc + n) * r mod 2^130-5 on 13-bit limb lists.
+
+    Static bound discipline (the fe25519 style, scaled down): ``t``
+    limbs < 2^15 (acc invariant < 2^14 plus a block limb < 2^13), ``r``
+    limbs < 2^13, so a 10-term schoolbook column is < 10*2^28 < 2^32 —
+    uint32 never wraps.  Three parallel carry rounds bring the 20
+    columns under 13 bits (the top column accumulates, never emits),
+    the 2^130 = 5 fold lands every limb under 2^22, and two wrap-fold
+    rounds restore the < 2^14 accumulator invariant."""
+    import jax.numpy as jnp
+
+    cols = [None] * (2 * _PLIMBS)
+    for k in range(2 * _PLIMBS - 1):
+        acc = None
+        for i in range(max(0, k - _PLIMBS + 1), min(_PLIMBS, k + 1)):
+            term = t[i] * r[k - i]
+            acc = term if acc is None else acc + term
+        cols[k] = acc
+    cols[2 * _PLIMBS - 1] = jnp.zeros_like(cols[0])
+    for _ in range(3):
+        carries = [cols[k] >> _PBITS for k in range(2 * _PLIMBS - 1)]
+        nxt = [cols[0] & _PMASK]
+        for k in range(1, 2 * _PLIMBS - 1):
+            nxt.append((cols[k] & _PMASK) + carries[k - 1])
+        nxt.append(cols[2 * _PLIMBS - 1] + carries[2 * _PLIMBS - 2])
+        cols = nxt
+    lo = [cols[j] + jnp.uint32(5) * cols[j + _PLIMBS] for j in range(_PLIMBS)]
+    for _ in range(2):
+        carries = [x >> _PBITS for x in lo]
+        nxt = [(lo[0] & _PMASK) + jnp.uint32(5) * carries[_PLIMBS - 1]]
+        for j in range(1, _PLIMBS):
+            nxt.append((lo[j] & _PMASK) + carries[j - 1])
+        lo = nxt
+    return lo
+
+
+def _poly_ripple(limbs, fold_carry: bool):
+    """Exact sequential carry over 10 limbs; the carry out of limb 9
+    (weight 2^130 = 5 mod p) folds into limb 0 when asked, else it is
+    returned for the caller's select."""
+    import jax.numpy as jnp
+
+    out = []
+    c = jnp.zeros_like(limbs[0])
+    for j in range(_PLIMBS):
+        v = limbs[j] + c
+        out.append(v & _PMASK)
+        c = v >> _PBITS
+    if fold_carry:
+        out[0] = out[0] + jnp.uint32(5) * c
+        return out, None
+    return out, c
+
+
+def _aead_fn(key_words, nonce_words, data_words, nbytes, *, seal: bool):
+    """(lanes, 8) key words + (lanes, 3) nonce words + (blocks, lanes,
+    16) zero-padded payload words + (lanes,) byte lengths -> ((blocks,
+    lanes, 16) output words masked to the lane length, (lanes, 4) tag
+    words).  ``seal``: payload is plaintext, MAC over the XOR output;
+    open: payload is ciphertext, MAC over the input."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    blocks, lanes = data_words.shape[0], data_words.shape[1]
+    key_rows = [key_words[:, i] for i in range(8)]
+    nonce_rows = [nonce_words[:, i] for i in range(3)]
+
+    # block 0 per lane: the Poly1305 one-time key (r clamped, s kept)
+    blk0 = _chacha_blocks(
+        key_rows, nonce_rows, jnp.zeros((1, lanes), jnp.uint32)
+    )
+    r_words = [
+        blk0[0][0] & jnp.uint32(0x0FFFFFFF),
+        blk0[1][0] & jnp.uint32(0x0FFFFFFC),
+        blk0[2][0] & jnp.uint32(0x0FFFFFFC),
+        blk0[3][0] & jnp.uint32(0x0FFFFFFC),
+    ]
+    s_words = [blk0[4 + i][0] for i in range(4)]
+    r = _limbs_of_words(r_words)
+
+    # keystream for counter blocks 1..blocks, all lanes at once
+    ctr = jnp.broadcast_to(
+        (jnp.arange(blocks, dtype=jnp.uint32) + 1)[:, None], (blocks, lanes)
+    )
+    ks = _chacha_blocks(key_rows, nonce_rows, ctr)
+
+    # XOR + per-word byte masks from the lane length (little-endian: the
+    # low k*8 bits of a word are its first k bytes)
+    xored, mac_words = [], []
+    for j in range(16):
+        off = (jnp.arange(blocks, dtype=jnp.int32) * 64 + 4 * j)[:, None]
+        k = jnp.clip(nbytes[None, :] - off, 0, 4)
+        kk = jnp.where(k >= 4, 0, k).astype(jnp.uint32)
+        mask = jnp.where(
+            k >= 4,
+            jnp.uint32(0xFFFFFFFF),
+            (jnp.uint32(1) << (kk * jnp.uint32(8))) - jnp.uint32(1),
+        )
+        dw = data_words[:, :, j]
+        xw = (dw ^ ks[j]) & mask
+        xored.append(xw)
+        mac_words.append(xw if seal else dw & mask)
+
+    # Poly1305 over the zero-padded ciphertext words: blocks*4 MAC
+    # blocks of 4 words each, per-lane live mask (RFC 8439 pad16 means
+    # every live MAC block is a full 16-byte block + the 2^128 bit)
+    mac = jnp.stack(mac_words, axis=1)  # (blocks, 16, lanes)
+    mac = mac.reshape(blocks * 4, 4, lanes)
+    nfull = (nbytes + 15) // 16  # live MAC blocks per lane
+
+    def step(acc, xs):
+        p, w4 = xs
+        n = _limbs_of_words([w4[0], w4[1], w4[2], w4[3]])
+        n[_PLIMBS - 1] = n[_PLIMBS - 1] + jnp.uint32(1 << 11)  # 2^128
+        t = [acc[i] + n[i] for i in range(_PLIMBS)]
+        new = _poly_mulmod(t, r)
+        live = p < nfull
+        return (
+            jnp.stack(
+                [jnp.where(live, nw, acc[i]) for i, nw in enumerate(new)]
+            ),
+            None,
+        )
+
+    acc0 = jnp.zeros((_PLIMBS, lanes), jnp.uint32)
+    acc, _ = lax.scan(
+        step, acc0, (jnp.arange(blocks * 4, dtype=jnp.int32), mac)
+    )
+
+    # final MAC block: le64(alen=0) || le64(clen), plus 2^128
+    lw = [
+        jnp.zeros((lanes,), jnp.uint32),
+        jnp.zeros((lanes,), jnp.uint32),
+        nbytes.astype(jnp.uint32),
+        jnp.zeros((lanes,), jnp.uint32),
+    ]
+    n = _limbs_of_words(lw)
+    n[_PLIMBS - 1] = n[_PLIMBS - 1] + jnp.uint32(1 << 11)
+    t = [acc[i] + n[i] for i in range(_PLIMBS)]
+    limbs = _poly_mulmod(t, r)
+
+    # canonicalize mod 2^130 (three ripples absorb every fold), then the
+    # g = acc + 5 trick selects acc mod p without a compare chain
+    limbs, _ = _poly_ripple(limbs, fold_carry=True)
+    limbs, _ = _poly_ripple(limbs, fold_carry=True)
+    limbs, _ = _poly_ripple(limbs, fold_carry=True)
+    g = list(limbs)
+    g[0] = g[0] + jnp.uint32(5)
+    g, cout = _poly_ripple(g, fold_carry=False)
+    ge = cout > 0  # acc >= p
+    limbs = [jnp.where(ge, g[j], limbs[j]) for j in range(_PLIMBS)]
+
+    # tag = (acc mod p + s) mod 2^128, as 4 uint32 words with carries
+    aw = _words_of_limbs(limbs)
+    tag_words = []
+    c = jnp.zeros((lanes,), jnp.uint32)
+    for i in range(4):
+        u = aw[i] + s_words[i]
+        c1 = (u < aw[i]).astype(jnp.uint32)
+        v = u + c
+        c2 = (v < u).astype(jnp.uint32)
+        tag_words.append(v)
+        c = c1 | c2
+    return jnp.stack(xored, axis=2), jnp.stack(tag_words, axis=1)
+
+
+def _seal_fn(key_words, nonce_words, data_words, nbytes):
+    return _aead_fn(key_words, nonce_words, data_words, nbytes, seal=True)
+
+
+def _open_fn(key_words, nonce_words, data_words, nbytes):
+    return _aead_fn(key_words, nonce_words, data_words, nbytes, seal=False)
+
+
+_JIT_LOCK = threading.Lock()
+_JIT: dict = {}
+
+
+def _jitted(op: str):
+    with _JIT_LOCK:
+        fn = _JIT.get(op)
+        if fn is None:
+            import jax
+
+            fn = jax.jit(_seal_fn if op == "seal" else _open_fn)
+            _JIT[op] = fn
+        return fn
+
+
+def kernel_tag(op: str, lanes: int, blocks: int) -> str:
+    return f"chacha-{lanes}x{blocks}-{op}"
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def max_lanes() -> int:
+    try:
+        return int(
+            os.environ.get("COMETBFT_TPU_AEAD_MAX_LANES", "")
+            or _MAX_LANES_DEFAULT
+        )
+    except ValueError:
+        return _MAX_LANES_DEFAULT
+
+
+def _bucket_shape(frames) -> "tuple[int, int] | None":
+    """(lanes, blocks) padding bucket for a frame batch, or None when
+    the batch exceeds the kernel's ladder (oversize frames / lane
+    budget) and must go to the host tiers."""
+    n = len(frames)
+    if n == 0 or n > max_lanes():
+        return None
+    lanes = _pow2_at_least(max(n, _MIN_LANES), _MIN_LANES)
+    need = max(1, max((len(d) + 63) // 64 for _, _, d in frames))
+    if need > _MAX_BLOCKS:
+        return None
+    blocks = _pow2_at_least(need, 1)
+    if lanes * blocks * 64 > _MAX_BATCH_BYTES:
+        return None
+    return lanes, blocks
+
+
+def _pack_frames(frames, lanes: int, blocks: int):
+    """Host-side packing: (lanes, 8) key words, (lanes, 3) nonce words,
+    (blocks, lanes, 16) zero-padded payload words (little-endian), and
+    (lanes,) int32 byte lengths."""
+    keys = np.zeros((lanes, 32), dtype=np.uint8)
+    nonces = np.zeros((lanes, 12), dtype=np.uint8)
+    buf = np.zeros((lanes, blocks * 64), dtype=np.uint8)
+    nbytes = np.zeros((lanes,), dtype=np.int32)
+    for i, (key, nonce, data) in enumerate(frames):
+        keys[i] = np.frombuffer(key, dtype=np.uint8)
+        nonces[i] = np.frombuffer(nonce, dtype=np.uint8)
+        if data:
+            buf[i, : len(data)] = np.frombuffer(data, dtype=np.uint8)
+        nbytes[i] = len(data)
+    key_words = np.ascontiguousarray(keys).view("<u4").astype(np.uint32)
+    nonce_words = np.ascontiguousarray(nonces).view("<u4").astype(np.uint32)
+    data_words = (
+        np.ascontiguousarray(buf)
+        .view("<u4")
+        .astype(np.uint32)
+        .reshape(lanes, blocks, 16)
+        .transpose(1, 0, 2)
+    )
+    return key_words, nonce_words, np.ascontiguousarray(data_words), nbytes
+
+
+def _unpack_outputs(out_words, tag_words, frames):
+    """Kernel outputs back to per-frame bytes: (out, tag) per frame."""
+    out = np.asarray(out_words)
+    tags = np.asarray(tag_words)
+    blocks = out.shape[0]
+    flat = (
+        out.transpose(1, 0, 2).reshape(out.shape[1], blocks * 16)
+    ).astype("<u4")
+    tag_bytes = tags.astype("<u4")
+    results = []
+    for i, (_, _, data) in enumerate(frames):
+        results.append(
+            (flat[i].tobytes()[: len(data)], tag_bytes[i].tobytes())
+        )
+    return results
+
+
+def device_pass(op, frames):
+    """The unguarded device AEAD pass (tests call this directly):
+    ``op`` in ("seal", "open"), ``frames`` a list of (key, nonce, data)
+    with ``data`` plaintext (seal) or tagless ciphertext (open).
+    Returns [(out_bytes, tag_bytes)].  Raises on any infra failure —
+    ``aead_pass`` wraps this with the breaker + host tiers."""
+    runner = aead_runner()
+    if runner is not None:
+        outs = runner(op, frames)
+    else:
+        shape = _bucket_shape(frames)
+        if shape is None:
+            raise ValueError("frame batch exceeds the device bucket ladder")
+        lanes, blocks = shape
+        from cometbft_tpu.ops import aot_cache
+
+        packed = _pack_frames(frames, lanes, blocks)
+        out_words, tag_words = aot_cache.cached_call(
+            _jitted(op), packed, kernel_tag(op, lanes, blocks)
+        )
+        outs = _unpack_outputs(out_words, tag_words, frames)
+    if len(outs) != len(frames):
+        # a lane-dropping device result is an infra fault, not a batch of
+        # missing frames — on the open path a silently dropped lane would
+        # read as an authentication failure (a verdict change)
+        raise RuntimeError(
+            f"device AEAD pass returned {len(outs)} lanes "
+            f"for {len(frames)} frames"
+        )
+    return outs
+
+
+def _breaker():
+    from cometbft_tpu.crypto import backend_health
+
+    return backend_health.registry().breaker(BREAKER)
+
+
+def aead_pass(op, frames):
+    """[(key, nonce, data)] -> ([(out, tag)], tier) through the
+    supervised device→numpy→pure ladder.  An infra fault on a tier
+    re-runs the WHOLE batch on the tier below — degradation can cost
+    latency, never a wrong byte or verdict."""
+    if device_active():
+        fits = aead_runner() is not None or _bucket_shape(frames) is not None
+        if fits:
+            breaker = _breaker()
+            if breaker.allow():
+                lanes = _pow2_at_least(
+                    max(len(frames), _MIN_LANES), _MIN_LANES
+                )
+                with tracing.span(
+                    "aead.dispatch", op=op, frames=len(frames), lanes=lanes
+                ) as sp:
+                    try:
+                        outs = device_pass(op, frames)
+                        breaker.record_success()
+                        tstats.record_dispatch("device", len(frames), lanes)
+                        sp.set(path="device")
+                        return outs, "device"
+                    except Exception as e:  # noqa: BLE001 — degrade,
+                        # never fail a socket write over infra
+                        breaker.record_failure(e)
+                        tstats.record_device_fallback()
+                        sp.set(path="fallback", error=type(e).__name__)
+                        tracing.record_anomaly(
+                            "aead_device_fault", error=type(e).__name__
+                        )
+    try:
+        outs = _host_pass(op, frames, pure=False)
+        tstats.record_dispatch("numpy", len(frames))
+        return outs, "numpy"
+    except Exception as e:  # noqa: BLE001 — numpy tier fault (missing
+        # numpy, dtype surprise): the pure tier below is dependency-free
+        tracing.record_anomaly(
+            "aead_numpy_fault", error=type(e).__name__
+        )
+    outs = _host_pass(op, frames, pure=True)
+    tstats.record_dispatch("pure", len(frames))
+    return outs, "pure"
+
+
+# -- supervised batch API -----------------------------------------------------
+
+
+def seal_frames(frames) -> "list[bytes]":
+    """[(key, nonce, plaintext)] -> [ciphertext||tag], bit-identical to
+    ``ChaCha20Poly1305Ref.encrypt`` with empty AAD on every frame."""
+    outs, _ = aead_pass("seal", frames)
+    return [ct + tag for ct, tag in outs]
+
+
+def _ct_eq(a: bytes, b: bytes) -> bool:
+    diff = 0
+    for x, y in zip(a, b):
+        diff |= x ^ y
+    return diff == 0 and len(a) == len(b)
+
+
+def open_frames(frames) -> "list":
+    """[(key, nonce, ciphertext||tag)] -> [plaintext | None] (None =
+    authentication failure).  Tag-verdict safety: an ACCEPT requires
+    the computed tag to match; a device-tier REJECT is re-verified on
+    the pure reference tier before it is allowed out, so an infra fault
+    can never reject a valid frame (it records a breaker failure and
+    serves the reference plaintext instead)."""
+    work, results = [], [None] * len(frames)
+    for i, (key, nonce, sealed) in enumerate(frames):
+        if len(sealed) < TAG_LEN:
+            tstats.record_bad_tag()
+            continue
+        work.append((i, key, nonce, sealed[:-TAG_LEN], sealed[-TAG_LEN:]))
+    if not work:
+        return results
+    outs, tier = aead_pass("open", [(k, n, ct) for _, k, n, ct, _ in work])
+    for (i, key, nonce, ct, want), (pt, got) in zip(work, outs):
+        if _ct_eq(got, want):
+            results[i] = pt
+            continue
+        if tier == "device":
+            # the reject path is the one place a corrupted device could
+            # change a VERDICT (an accept needs a 128-bit collision) —
+            # confirm every device reject on the pure reference tier
+            tstats.record_reject_confirm()
+            (ref_pt, ref_tag), = _host_pass(
+                "open", [(key, nonce, ct)], pure=True
+            )
+            if _ct_eq(ref_tag, want):
+                _breaker().record_failure(
+                    RuntimeError("device tag mismatch on a valid frame")
+                )
+                tracing.record_anomaly("aead_verdict_mismatch")
+                results[i] = ref_pt
+                continue
+        tstats.record_bad_tag()
+    return results
+
+
+# -- warm-boot hooks ----------------------------------------------------------
+
+_WARM_BLOCKS = 16  # covers DATA_MAX_SIZE (1024-byte) transport frames
+
+
+def warm_kernels(lanes: int) -> "dict[str, dict]":
+    """Resolve the seal + open executables for one lanes bucket without
+    dispatching — the ``ops/warmboot`` ``transport`` family seam.
+    Returns {exec-cache tag: info}."""
+    import jax
+
+    from cometbft_tpu.ops import aot_cache
+
+    u = jax.ShapeDtypeStruct
+    infos = {}
+    for op in ("seal", "open"):
+        tag = kernel_tag(op, lanes, _WARM_BLOCKS)
+        _, info = aot_cache.load_or_compile(
+            _jitted(op),
+            (
+                u((lanes, 8), np.uint32),
+                u((lanes, 3), np.uint32),
+                u((_WARM_BLOCKS, lanes, 16), np.uint32),
+                u((lanes,), np.int32),
+            ),
+            tag,
+        )
+        infos[tag] = info
+    return infos

@@ -58,6 +58,7 @@ DEFAULT_BLS_BUCKETS = (2, 4, 8)
 # sha256 tree kernel lane buckets (docs/proof-serving.md): 64 covers the
 # common tx-count range; bigger buckets compile on first use
 DEFAULT_MERKLE_BUCKETS = (64,)
+DEFAULT_TRANSPORT_BUCKETS = (8,)
 
 
 def enabled() -> bool:
@@ -112,6 +113,11 @@ def extra_matrix() -> "list[tuple[str, str, int]]":
         "COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", DEFAULT_MERKLE_BUCKETS
     ):
         shapes.append(("merkle_device", "sha256-tree", b))
+    for b in _env_sizes(
+        "COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS", DEFAULT_TRANSPORT_BUCKETS
+    ):
+        shapes.append(("aead_device", "transport-aead", b))
+        shapes.append(("x25519_device", "transport-x25519", b))
     return shapes
 
 
@@ -129,6 +135,16 @@ def _warm_extra(family: str, lanes: int) -> "dict[str, dict]":
         from cometbft_tpu.ops import sha256_tree
 
         return sha256_tree.warm_kernels(lanes)
+    if family == "transport-aead":
+        from cometbft_tpu.ops import chacha_aead
+
+        return chacha_aead.warm_kernels(lanes)
+    if family == "transport-x25519":
+        from cometbft_tpu.ops import x25519_ladder
+
+        return {
+            x25519_ladder.ladder_tag(lanes): x25519_ladder.warm_ladder(lanes)
+        }
     from cometbft_tpu.ops import bls_g1
 
     return bls_g1.warm_kernels(lanes)

@@ -1,0 +1,356 @@
+"""Batched X25519 (RFC 7748) Montgomery ladder on the fe25519 field layer.
+
+``crypto/aead_ref.x25519`` is one Python-bigint ladder per exchange —
+fine for a single dial, hopeless for connection-storm admission
+(ROADMAP item 4).  This module runs a whole batch of pending exchanges
+through ONE fixed-structure ladder pass, vectorized over lanes:
+
+  * scalars are clamped on the host (RFC 7748 §5 decoding) and shipped
+    as a ``(255, lanes)`` bit tensor, most-significant bit first — the
+    loop structure is constant per the RFC (the verified high-speed
+    X25519 paper's ladder playbook: arithmetic conditional swaps, no
+    data-dependent branches);
+  * u-coordinates ship as raw ``(lanes, 32)`` bytes and are unpacked to
+    13-bit limbs on device (``fe25519.unpack255`` masks the MSB exactly
+    like the reference's u-decoding);
+  * each ladder step is the RFC 7748 x2/z2/x3/z3 update (5 muls + 4
+    squares + the a24 small-multiply) on ``ops/fe25519``'s statically
+    bound-checked signed-limb arithmetic; the final ``x2 * z2^(p-2)``
+    uses the standard 2^255-21 addition chain and ``fe.freeze`` yields
+    canonical limbs.
+
+Supervision mirrors ``ops/sha256_tree.py``: executables ride
+``ops/aot_cache`` (tags ``x25519-{lanes}``) and the warm-boot
+``transport`` family; the ``x25519_device`` breaker + per-pair host
+fallback make degradation supervised (an infra fault re-derives every
+shared secret on the host reference — it can cost latency, never a
+wrong secret); ``set_ladder_runner`` is the host-oracle seam the
+``dial-storm`` scenario and the transport bench drive.
+
+``COMETBFT_TPU_X25519_DEVICE=0`` pins every exchange to the host
+reference.  ``p2p/handshake_pool.py`` is the production caller: it
+coalesces concurrent dials into these batches.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from cometbft_tpu.crypto import aead_ref
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.p2p import transport_stats as tstats
+
+BREAKER = "x25519_device"
+
+_MIN_LANES = 8
+_MAX_LANES_DEFAULT = 256
+
+BASE_U = (9).to_bytes(32, "little")
+_A24 = 121665
+
+
+def enabled() -> bool:
+    """COMETBFT_TPU_X25519_DEVICE=0 pins every exchange to the host."""
+    return os.environ.get("COMETBFT_TPU_X25519_DEVICE", "1") != "0"
+
+
+def _backend_trusted() -> bool:
+    from cometbft_tpu.crypto import batch as cbatch
+
+    env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
+    if env and env != "auto":
+        return env == "tpu"
+    return cbatch._DEFAULT_BACKEND == "tpu"
+
+
+# -- host-oracle runner seam --------------------------------------------------
+
+_RUNNER_LOCK = threading.Lock()
+_LADDER_RUNNER: "list" = [None]
+
+
+def set_ladder_runner(fn) -> None:
+    """Install a stand-in for the device ladder pass: ``fn(pairs) ->
+    [shared32]`` with ``pairs`` a list of (scalar32, u32) byte tuples.
+    The dial-storm scenario and the transport bench pin the host oracle
+    here — mirroring ``sha256_tree.set_tree_runner``."""
+    with _RUNNER_LOCK:
+        _LADDER_RUNNER[0] = fn
+
+
+def clear_ladder_runner() -> None:
+    with _RUNNER_LOCK:
+        _LADDER_RUNNER[0] = None
+
+
+def ladder_runner():
+    with _RUNNER_LOCK:
+        return _LADDER_RUNNER[0]
+
+
+def host_exchange(pairs) -> "list[bytes]":
+    """The host ZIP of the ladder kernel — byte-identical by
+    construction (it IS the kernel's differential oracle)."""
+    return [aead_ref.x25519(scalar, u) for scalar, u in pairs]
+
+
+def host_ladder_runner(pairs) -> "list[bytes]":
+    return host_exchange(pairs)
+
+
+def device_active() -> bool:
+    if ladder_runner() is not None:
+        return enabled()
+    return enabled() and _backend_trusted()
+
+
+# -- device kernel ------------------------------------------------------------
+
+
+def _inv(z):
+    """z^(p-2) = z^(2^255 - 21): the curve25519 inversion addition
+    chain (squares via fori_loop, 12 muls)."""
+    from cometbft_tpu.ops import fe25519 as fe
+
+    z = fe.red(z)
+    z2 = fe.red(fe.square(z))
+    z8 = fe._nsquares(z2, 2)
+    z9 = fe.red(fe.mul(z8, z))
+    z11 = fe.red(fe.mul(z9, z2))
+    z22 = fe.red(fe.square(z11))
+    z_5_0 = fe.red(fe.mul(z22, z9))  # 2^5 - 1
+    z_10_0 = fe.red(fe.mul(fe._nsquares(z_5_0, 5), z_5_0))
+    z_20_0 = fe.red(fe.mul(fe._nsquares(z_10_0, 10), z_10_0))
+    z_40_0 = fe.red(fe.mul(fe._nsquares(z_20_0, 20), z_20_0))
+    z_50_0 = fe.red(fe.mul(fe._nsquares(z_40_0, 10), z_10_0))
+    z_100_0 = fe.red(fe.mul(fe._nsquares(z_50_0, 50), z_50_0))
+    z_200_0 = fe.red(fe.mul(fe._nsquares(z_100_0, 100), z_100_0))
+    z_250_0 = fe.red(fe.mul(fe._nsquares(z_200_0, 50), z_50_0))
+    return fe.red(fe.mul(fe._nsquares(z_250_0, 5), z11))  # 2^255 - 21
+
+
+def _ladder_fn(bits, u_bytes):
+    """(255, lanes) int32 scalar bits (MSB first, pre-clamped) +
+    (lanes, 32) uint8 u-coordinates -> (20, lanes) int32 canonical
+    limbs of the shared u-coordinate."""
+    import jax
+    import jax.numpy as jnp
+
+    from cometbft_tpu.ops import fe25519 as fe
+
+    lanes = u_bytes.shape[0]
+    x1_raw, _ = fe.unpack255(u_bytes)
+    x1 = fe.red(x1_raw)
+
+    def body(carry, kt):
+        x2, z2, x3, z3, swap = carry
+        sw = (swap ^ kt) != 0
+        x2s, x3s = fe.select(sw, x3, x2), fe.select(sw, x2, x3)
+        z2s, z3s = fe.select(sw, z3, z2), fe.select(sw, z2, z3)
+        a = fe.add(x2s, z2s)
+        aa = fe.square(a)
+        b = fe.sub(x2s, z2s)
+        bb = fe.square(b)
+        e = fe.sub(aa, bb)
+        c = fe.add(x3s, z3s)
+        d = fe.sub(x3s, z3s)
+        da = fe.mul(d, a)
+        cb = fe.mul(c, b)
+        x3n = fe.square(fe.add(da, cb))
+        z3n = fe.mul(x1, fe.square(fe.sub(da, cb)))
+        x2n = fe.mul(aa, bb)
+        z2n = fe.mul(e, fe.add(aa, fe.mul_small(e, _A24)))
+        return (
+            fe.red(x2n),
+            fe.red(z2n),
+            fe.red(x3n),
+            fe.red(z3n),
+            kt,
+        ), None
+
+    init = (
+        fe.red(fe.const(1, lanes)),
+        fe.red(fe.const(0, lanes)),
+        fe.red(x1_raw),
+        fe.red(fe.const(1, lanes)),
+        jnp.zeros((lanes,), jnp.int32),
+    )
+    (x2, z2, x3, z3, swap), _ = jax.lax.scan(body, init, bits)
+    sw = swap != 0
+    x2 = fe.select(sw, x3, x2)
+    z2 = fe.select(sw, z3, z2)
+    return fe.freeze(fe.mul(x2, _inv(z2)))
+
+
+_JIT_LOCK = threading.Lock()
+_JIT: "list" = [None]
+
+
+def _jitted():
+    with _JIT_LOCK:
+        fn = _JIT[0]
+        if fn is None:
+            import jax
+
+            fn = jax.jit(_ladder_fn)
+            _JIT[0] = fn
+        return fn
+
+
+def ladder_tag(lanes: int) -> str:
+    return f"x25519-{lanes}"
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def max_lanes() -> int:
+    try:
+        return int(
+            os.environ.get("COMETBFT_TPU_X25519_MAX_LANES", "")
+            or _MAX_LANES_DEFAULT
+        )
+    except ValueError:
+        return _MAX_LANES_DEFAULT
+
+
+def bucket_lanes(n: int) -> "int | None":
+    if n == 0 or n > max_lanes():
+        return None
+    return _pow2_at_least(max(n, _MIN_LANES), _MIN_LANES)
+
+
+def _pack_pairs(pairs, lanes: int):
+    """(255, lanes) int32 clamped scalar bits (MSB first) + (lanes, 32)
+    uint8 u-coordinates; idle lanes ride the base point with a valid
+    clamped zero scalar."""
+    scalars = np.zeros((lanes, 32), dtype=np.uint8)
+    us = np.tile(
+        np.frombuffer(BASE_U, dtype=np.uint8), (lanes, 1)
+    )
+    for i, (scalar, u) in enumerate(pairs):
+        b = bytearray(scalar)
+        b[0] &= 248
+        b[31] &= 127
+        b[31] |= 64
+        scalars[i] = np.frombuffer(bytes(b), dtype=np.uint8)
+        us[i] = np.frombuffer(u, dtype=np.uint8)
+    # clamp the pad lanes too (bit 254 set keeps them on the main path)
+    for i in range(len(pairs), lanes):
+        scalars[i, 31] |= 64
+    bits_le = np.unpackbits(scalars, axis=1, bitorder="little")
+    bits = (
+        bits_le[:, :255][:, ::-1].T.astype(np.int32)
+    )  # (255, lanes), row 0 = bit 254
+    return np.ascontiguousarray(bits), np.ascontiguousarray(us)
+
+
+def _limbs_to_bytes(limbs, count: int) -> "list[bytes]":
+    arr = np.asarray(limbs)
+    out = []
+    for i in range(count):
+        v = 0
+        for j in reversed(range(arr.shape[0])):
+            v = (v << 13) | int(arr[j, i])
+        out.append(v.to_bytes(32, "little"))
+    return out
+
+
+def device_exchange(pairs) -> "list[bytes]":
+    """The unguarded device ladder pass (tests call this directly).
+    Raises on any infra failure — ``exchange_batch`` wraps this with
+    the breaker + host fallback."""
+    runner = ladder_runner()
+    if runner is not None:
+        out = runner(pairs)
+    else:
+        lanes = bucket_lanes(len(pairs))
+        if lanes is None:
+            raise ValueError("exchange batch exceeds the device lane ladder")
+        from cometbft_tpu.ops import aot_cache
+
+        bits, us = _pack_pairs(pairs, lanes)
+        limbs = aot_cache.cached_call(
+            _jitted(), (bits, us), ladder_tag(lanes)
+        )
+        out = _limbs_to_bytes(limbs, len(pairs))
+    if len(out) != len(pairs):
+        # a lane-dropping device result is an infra fault — surfacing it
+        # here lets the breaker degrade to the host reference instead of
+        # handing a caller someone else's shared secret
+        raise RuntimeError(
+            f"device ladder pass returned {len(out)} lanes "
+            f"for {len(pairs)} pairs"
+        )
+    return out
+
+
+def _breaker():
+    from cometbft_tpu.crypto import backend_health
+
+    return backend_health.registry().breaker(BREAKER)
+
+
+def exchange_batch(pairs) -> "list[bytes]":
+    """[(scalar32, u32)] -> [shared32] through the supervised
+    device→host ladder: an infra failure records an ``x25519_device``
+    breaker failure and re-derives every pair on the host reference —
+    never a wrong (or missing) secret."""
+    if not pairs:
+        return []
+    if device_active():
+        fits = ladder_runner() is not None or bucket_lanes(len(pairs))
+        if fits:
+            breaker = _breaker()
+            if breaker.allow():
+                lanes = _pow2_at_least(
+                    max(len(pairs), _MIN_LANES), _MIN_LANES
+                )
+                with tracing.span(
+                    "x25519.ladder", pairs=len(pairs), lanes=lanes
+                ) as sp:
+                    try:
+                        out = device_exchange(pairs)
+                        breaker.record_success()
+                        tstats.record_hs_dispatch(
+                            True, len(pairs), lanes
+                        )
+                        sp.set(path="device")
+                        return out
+                    except Exception as e:  # noqa: BLE001 — degrade,
+                        # never drop a connection over infra
+                        breaker.record_failure(e)
+                        sp.set(path="fallback", error=type(e).__name__)
+                        tracing.record_anomaly(
+                            "x25519_device_fault",
+                            error=type(e).__name__,
+                        )
+    out = host_exchange(pairs)
+    tstats.record_hs_dispatch(False, len(pairs))
+    return out
+
+
+# -- warm-boot hooks ----------------------------------------------------------
+
+
+def warm_ladder(lanes: int) -> dict:
+    """Resolve the ladder executable for one lanes bucket without
+    dispatching — the ``ops/warmboot`` ``transport`` family seam."""
+    import jax
+
+    from cometbft_tpu.ops import aot_cache
+
+    u = jax.ShapeDtypeStruct
+    _, info = aot_cache.load_or_compile(
+        _jitted(),
+        (u((255, lanes), np.int32), u((lanes, 32), np.uint8)),
+        ladder_tag(lanes),
+    )
+    return info

@@ -211,13 +211,27 @@ class MConnection:
                 best, best_ratio = ch, ratio
         return best
 
+    def _write_frames(self, frames: "list[bytes]") -> None:
+        """One coalesced write when the stream supports it (the
+        SecretConnection transport plane seals the whole flush in one
+        AEAD pass); per-frame writes otherwise.  Same bytes either way."""
+        wf = getattr(self.stream, "write_frames", None)
+        if wf is not None:
+            wf(frames)
+        else:
+            for f in frames:
+                self.stream.write_frame(f)
+
     def _send_routine(self) -> None:
         last_ping = time.monotonic()
         try:
             while not self._stopped.is_set():
+                # collect this wakeup's frames — pings, pongs and packets —
+                # and flush them as ONE coalesced write at the end
+                frames: "list[bytes]" = []
                 now = time.monotonic()
                 if now - last_ping >= self.ping_interval:
-                    self.stream.write_frame(bytes([_PKT_PING]))
+                    frames.append(bytes([_PKT_PING]))
                     last_ping = now
                     if self._pong_pending and (
                         now - self._last_pong > self.pong_timeout
@@ -229,7 +243,7 @@ class MConnection:
                 # from one thread (reference: pongs go through send channels)
                 while self._pongs_owed > 0:
                     self._pongs_owed -= 1
-                    self.stream.write_frame(bytes([_PKT_PONG]))
+                    frames.append(bytes([_PKT_PONG]))
 
                 sent_any = False
                 # batch up to 10 packets per wakeup, then re-check signals
@@ -243,9 +257,11 @@ class MConnection:
                     )
                     if self.send_rate:
                         self.send_monitor.limit(len(pkt), self.send_rate)
-                    self.stream.write_frame(pkt)
+                    frames.append(pkt)
                     self.send_monitor.update(len(pkt))
                     sent_any = True
+                if frames:
+                    self._write_frames(frames)
                 if not sent_any:
                     self._send_signal.wait(timeout=FLUSH_THROTTLE * 10)
                     self._send_signal.clear()

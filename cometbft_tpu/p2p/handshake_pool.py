@@ -1,0 +1,382 @@
+"""Batched X25519 handshake admission (the verifysched idiom for dials).
+
+A connection storm presents hundreds of concurrent ``SecretConnection``
+handshakes, and each one historically ran its own pure-Python Montgomery
+ladder (~1ms of host arithmetic) inline on the dialing thread.  This
+module is the coalescer in front of ``ops/x25519_ladder``: callers
+``exchange(scalar, peer_pub)`` and block on a Future while one dispatcher
+thread fuses every pending exchange ACROSS all dialing threads into a
+single bucket-padded ladder dispatch, flushing when the oldest waiter has
+aged ``COMETBFT_TPU_HANDSHAKE_FLUSH_US`` (~2000) or a full batch
+(``COMETBFT_TPU_HANDSHAKE_MAX_BATCH``) accumulates.
+
+Shed-to-sync-dial, never a dropped connection: the queue is bounded
+(``COMETBFT_TPU_HANDSHAKE_QUEUE``); at capacity — or if a future times
+out under a wedged dispatcher — the caller falls back to the synchronous
+host ladder (``sync_exchange``).  Shedding costs the batching win, never
+the handshake.  Every pool result is produced by ``exchange_batch``,
+whose supervisor degrades device faults to the host oracle, so a pool
+answer and a sync answer are always the same bytes.
+
+Activation mirrors verifysched: ``COMETBFT_TPU_HANDSHAKE`` != 0 (default
+on) AND the ladder device path is live (``x25519_ladder.device_active()``
+— trusted backend or an installed runner seam).  Inactive, the pool is
+bypassed entirely and ``exchange`` IS the synchronous host ladder, so
+the kill switch restores prior behavior bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Optional, Sequence
+
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.ops import x25519_ladder
+from cometbft_tpu.p2p import transport_stats as tstats
+
+logger = logging.getLogger("cometbft_tpu.p2p.handshake_pool")
+
+DEFAULT_FLUSH_US = 2000.0
+DEFAULT_QUEUE_CAP = 1024
+DEFAULT_MAX_BATCH = 256
+DEFAULT_TIMEOUT_S = 5.0
+
+
+class QueueFullError(Exception):
+    """Admission control rejected a submission (backpressure).  The caller
+    dials synchronously instead — shed costs coalescing, never the
+    connection."""
+
+
+def enabled() -> bool:
+    return os.environ.get("COMETBFT_TPU_HANDSHAKE", "1") != "0"
+
+
+def active() -> bool:
+    """True when exchanges should take the pool path: kill switch on AND
+    the batched ladder has a live device path (trusted backend or runner
+    seam).  A host-only node keeps the direct synchronous ladder — there
+    is no dispatch floor to amortize, so queueing would be pure latency."""
+    return enabled() and x25519_ladder.device_active()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def sync_exchange(scalar: bytes, peer_pub: bytes) -> bytes:
+    """The synchronous fallback every shed/timeout/inactive path takes:
+    one host-oracle ladder, verdict-identical to the pool (the pool's
+    supervisor bottoms out on this exact function)."""
+    return x25519_ladder.host_exchange([(scalar, peer_pub)])[0]
+
+
+class _Req:
+    __slots__ = ("pair", "future", "t0")
+
+    def __init__(self, pair, future, t0):
+        self.pair = pair
+        self.future = future
+        self.t0 = t0
+
+
+class HandshakePool:
+    """One dispatcher thread over a bounded FIFO of pending exchanges.
+    Thread-safe; lazily starts (and restarts, if it ever died) its thread
+    on the first queued submission and drains everything (reason
+    ``shutdown``) on ``close()`` — a future handed out is always
+    eventually resolved."""
+
+    def __init__(
+        self,
+        flush_us: Optional[float] = None,
+        queue_cap: Optional[int] = None,
+        max_batch: Optional[int] = None,
+    ):
+        if flush_us is None:
+            flush_us = _env_float(
+                "COMETBFT_TPU_HANDSHAKE_FLUSH_US", DEFAULT_FLUSH_US
+            )
+        if queue_cap is None:
+            queue_cap = _env_int(
+                "COMETBFT_TPU_HANDSHAKE_QUEUE", DEFAULT_QUEUE_CAP
+            )
+        if max_batch is None:
+            max_batch = _env_int(
+                "COMETBFT_TPU_HANDSHAKE_MAX_BATCH", DEFAULT_MAX_BATCH
+            )
+        self.flush_s = max(float(flush_us), 0.0) / 1e6
+        self.queue_cap = max(int(queue_cap), 1)
+        self.max_batch = max(int(max_batch), 1)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: "deque[_Req]" = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._paused = False
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, scalar: bytes, peer_pub: bytes) -> "Future[bytes]":
+        """Queue one exchange; returns a Future resolving to the 32-byte
+        shared secret.  Raises ``QueueFullError`` at capacity — the caller
+        runs ``sync_exchange`` instead."""
+        fut: "Future[bytes]" = Future()
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("handshake pool is stopped")
+            if len(self._queue) >= self.queue_cap:
+                raise QueueFullError(
+                    f"handshake queue at capacity ({self.queue_cap}); "
+                    "shedding to the synchronous dial"
+                )
+            self._queue.append(
+                _Req((bytes(scalar), bytes(peer_pub)), fut,
+                     time.perf_counter())
+            )
+            tstats.record_hs_enqueued()
+            if self._thread is None or not self._thread.is_alive():
+                # lazily started — and RESTARTED if it ever died: without
+                # this, every queued dial would hang until its timeout
+                # and the pool would silently become all-sync
+                if self._thread is not None:
+                    logger.error(
+                        "handshake dispatcher thread died; restarting "
+                        "(%d dials pending)",
+                        len(self._queue),
+                    )
+                self._thread = threading.Thread(
+                    target=self._run, name="handshake-pool", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return fut
+
+    # -- test/bench hooks -------------------------------------------------
+
+    def pause(self) -> None:
+        """Hold flushing (test/bench hook: build a deterministic backlog
+        that resumes as one coalesced dispatch)."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting work, drain the queue (reason ``shutdown``) and
+        join the dispatcher.  Every outstanding future resolves."""
+        with self._cond:
+            self._stopped = True
+            self._paused = False
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout_s)
+            if t.is_alive():
+                logger.warning(
+                    "handshake pool dispatcher still alive %.1fs after "
+                    "close() — a wedged flush will finish under whatever "
+                    "global state exists when it unwedges",
+                    timeout_s,
+                )
+
+    # -- dispatcher -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stopped and (
+                    not self._queue or self._paused
+                ):
+                    self._cond.wait()
+                if self._stopped and not self._queue:
+                    return
+                reason = "shutdown"
+                if not self._stopped:
+                    while True:
+                        if self._stopped or self._paused:
+                            break
+                        if len(self._queue) >= self.max_batch:
+                            reason = "full"
+                            break
+                        if not self._queue:
+                            break
+                        remain = (
+                            self._queue[0].t0
+                            + self.flush_s
+                            - time.perf_counter()
+                        )
+                        if remain <= 0:
+                            reason = "deadline"
+                            break
+                        self._cond.wait(remain)
+                    if self._paused and not self._stopped:
+                        continue
+                    if not self._queue:
+                        continue
+                batch: "list[_Req]" = []
+                while self._queue and len(batch) < self.max_batch:
+                    batch.append(self._queue.popleft())
+            if batch:
+                self._execute(batch, reason)
+
+    def _execute(self, batch: "list[_Req]", reason: str) -> None:
+        n = len(batch)
+        try:
+            with tracing.span("handshake.flush", reason=reason, items=n):
+                results = x25519_ladder.exchange_batch(
+                    [r.pair for r in batch]
+                )
+            # record BEFORE resolving: a caller reading stats right after
+            # its secret (the sim's end-of-run capture asserts
+            # hs_queue_depth == 0) must not race the bookkeeping
+            tstats.record_hs_flush(reason, n)
+            for r, secret in zip(batch, results):
+                r.future.set_result(secret)
+        except BaseException as e:  # noqa: BLE001 — futures must ALWAYS
+            # resolve: these dials left the queue, so the submit-path
+            # restart can never recover them
+            logger.exception(
+                "handshake flush failed unexpectedly; resolving %d dials "
+                "on the host ladder",
+                n,
+            )
+            tstats.record_hs_flush(reason, n)
+            for r in batch:
+                if r.future.done():
+                    continue
+                try:
+                    r.future.set_result(
+                        x25519_ladder.host_exchange([r.pair])[0]
+                    )
+                except Exception as inner:  # noqa: BLE001 — malformed
+                    # input (wrong-length key) surfaces to the caller
+                    r.future.set_exception(inner)
+            if not isinstance(e, Exception):
+                raise  # SystemExit etc.: die, but only AFTER resolving
+
+
+# -- process-wide instance ----------------------------------------------------
+
+_POOL: Optional[HandshakePool] = None
+_POOL_LOCK = threading.Lock()
+
+
+def get_pool() -> HandshakePool:
+    """The process-wide pool (every dialing thread shares one — that
+    sharing IS the optimization)."""
+    global _POOL
+    if _POOL is None:
+        with _POOL_LOCK:
+            if _POOL is None:
+                _POOL = HandshakePool()
+    return _POOL
+
+
+def reset_pool() -> None:
+    """Drain + drop the process-wide pool (tests/sim; also re-reads the
+    env knobs on next use)."""
+    global _POOL
+    with _POOL_LOCK:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.close()
+
+
+# -- call-site wrappers -------------------------------------------------------
+
+
+def _timeout_s() -> float:
+    return _env_float("COMETBFT_TPU_HANDSHAKE_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+
+
+def exchange(scalar: bytes, peer_pub: bytes) -> bytes:
+    """THE drop-in for a SecretConnection ECDH: pool-coalesced when
+    active, synchronous host ladder otherwise.  Shed or timed out, the
+    caller's dial proceeds synchronously — a handshake is never dropped
+    by the coalescer.  Raises ``ValueError`` for malformed key lengths
+    (same contract as the reference ladder)."""
+    if not active():
+        tstats.record_handshake("sync")
+        return sync_exchange(scalar, peer_pub)
+    try:
+        fut = get_pool().submit(scalar, peer_pub)
+    except (QueueFullError, RuntimeError):
+        # at capacity, or the pool torn down under us (reset race)
+        tstats.record_hs_shed()
+        tstats.record_handshake("sync")
+        tracing.record_anomaly("handshake_shed", queue_cap=get_pool().queue_cap)
+        return sync_exchange(scalar, peer_pub)
+    try:
+        out = fut.result(_timeout_s())
+    except FutureTimeoutError:
+        # wedged dispatcher: the dial must not hang — answer it
+        # synchronously; the straggling flush resolves the orphaned
+        # future harmlessly later
+        tstats.record_hs_shed()
+        tstats.record_handshake("sync")
+        tracing.record_anomaly("handshake_timeout", timeout_s=_timeout_s())
+        return sync_exchange(scalar, peer_pub)
+    tstats.record_handshake("pool")
+    return out
+
+
+def exchange_many(
+    pairs: "Sequence[tuple[bytes, bytes]]",
+) -> "list[bytes]":
+    """Several exchanges submitted before waiting on any, so they ride one
+    flush (bench/tests).  Shed entries fall back synchronously per item."""
+    futs: "list[Optional[Future]]" = []
+    if active():
+        pool = get_pool()
+        for s, u in pairs:
+            try:
+                futs.append(pool.submit(s, u))
+            except (QueueFullError, RuntimeError):
+                tstats.record_hs_shed()
+                futs.append(None)
+    else:
+        futs = [None] * len(pairs)
+    out: "list[bytes]" = []
+    for f, (s, u) in zip(futs, pairs):
+        if f is None:
+            tstats.record_handshake("sync")
+            out.append(sync_exchange(s, u))
+            continue
+        try:
+            out.append(f.result(_timeout_s()))
+            tstats.record_handshake("pool")
+        except FutureTimeoutError:
+            tstats.record_hs_shed()
+            tstats.record_handshake("sync")
+            out.append(sync_exchange(s, u))
+    return out
+
+
+def public_key(scalar: bytes) -> bytes:
+    """X25519 public key derivation — a ladder over the base point, so it
+    coalesces into the same flushes as the exchanges it precedes."""
+    return exchange(scalar, x25519_ladder.BASE_U)

@@ -17,6 +17,15 @@ Every frame is a fixed-layout AEAD record: 4-byte BE length of the sealed
 payload, then ciphertext.  Nonces are 12-byte little-endian counters, one
 counter per direction; plaintext frames are chunked to at most 1024 bytes
 (reference: dataMaxSize, secret_connection.go:47).
+
+Transport data plane (docs/transport-plane.md): batches of frames route
+through ``p2p/transportplane`` — one coalesced AEAD pass over every frame
+in a send flush (``write_frames``) or every complete frame already in the
+receive buffer (``read_frame``'s opportunistic batch) — and the ephemeral
+ECDH routes through the ``p2p/handshake_pool`` coalescer when its device
+ladder is live.  Wire bytes, nonce sequence and error positions are
+bit-identical to the serial path; ``COMETBFT_TPU_AEAD=0`` and
+``COMETBFT_TPU_HANDSHAKE=0`` restore it outright.
 """
 
 from __future__ import annotations
@@ -26,7 +35,8 @@ import hmac as _hmac
 import os
 import socket as _socket
 import struct
-from typing import Optional
+from collections import deque
+from typing import Optional, Sequence
 
 try:
     from cryptography.exceptions import InvalidTag
@@ -45,6 +55,7 @@ except ImportError:  # no C library: pure-Python RFC 7748/8439 fallback
 
 from cometbft_tpu.crypto.keys import Ed25519PrivKey, Ed25519PubKey
 from cometbft_tpu.libs import protoenc as pe
+from cometbft_tpu.p2p import handshake_pool, transportplane
 
 DATA_MAX_SIZE = 1024
 _HKDF_INFO = b"COMETBFT_TPU_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
@@ -83,9 +94,13 @@ def derive_secrets(
 
 
 class _HalfDuplex:
-    """One direction of AEAD frames with a counter nonce."""
+    """One direction of AEAD frames with a counter nonce.  Batches route
+    through the transport plane (one coalesced device/host pass over the
+    whole batch at consecutive nonces); singles and sub-threshold batches
+    keep the serial per-frame path, which is the pre-plane code verbatim."""
 
     def __init__(self, key: bytes):
+        self._key = key
         self.aead = ChaCha20Poly1305(key)
         self.nonce = 0
 
@@ -102,6 +117,42 @@ class _HalfDuplex:
         except InvalidTag as e:
             raise SecretConnectionError("AEAD authentication failed") from e
 
+    def seal_batch(self, plaintexts: "Sequence[bytes]") -> "list[bytes]":
+        if transportplane.batch_active(len(plaintexts)):
+            start = self.nonce
+            self.nonce += len(plaintexts)
+            return transportplane.seal_frames(self._key, start, plaintexts)
+        transportplane.record_serial_frames(len(plaintexts))
+        return [self.seal(p) for p in plaintexts]
+
+    def open_batch(
+        self, ciphertexts: "Sequence[bytes]"
+    ) -> "tuple[list[bytes], Optional[SecretConnectionError]]":
+        """Verify+decrypt a batch; returns the authenticated plaintext
+        prefix plus the error that would have been raised at the first
+        bad frame (``None`` when all verified) — exactly the serial
+        loop's delivery semantics."""
+        if transportplane.batch_active(len(ciphertexts)):
+            start = self.nonce
+            self.nonce += len(ciphertexts)
+            pts, bad = transportplane.open_frames(
+                self._key, start, ciphertexts
+            )
+            err = (
+                None
+                if bad is None
+                else SecretConnectionError("AEAD authentication failed")
+            )
+            return pts, err
+        transportplane.record_serial_frames(len(ciphertexts))
+        out: "list[bytes]" = []
+        for c in ciphertexts:
+            try:
+                out.append(self.open(c))
+            except SecretConnectionError as e:
+                return out, e
+        return out, None
+
 
 class SecretConnection:
     """Encrypted, authenticated stream over a raw socket-like object.
@@ -113,9 +164,23 @@ class SecretConnection:
     def __init__(self, sock, priv_key: Ed25519PrivKey):
         self._sock = sock
         self._recv_buf = b""
+        # batched receive state: plaintexts already authenticated ahead
+        # of delivery, and the deferred error that ends the stream at the
+        # exact frame position the serial path would have raised it
+        self._plain: "deque[bytes]" = deque()
+        self._recv_err: Optional[Exception] = None
 
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes_raw()
+        # ephemeral keypair: the handshake pool coalesces the two ladder
+        # evaluations (pubkey derivation + ECDH) across every concurrent
+        # dial into batched device dispatches; pool inactive, this is the
+        # original direct path
+        use_pool = handshake_pool.active()
+        if use_pool:
+            eph_raw = os.urandom(32)
+            eph_pub = handshake_pool.public_key(eph_raw)
+        else:
+            eph_priv = X25519PrivateKey.generate()
+            eph_pub = eph_priv.public_key().public_bytes_raw()
 
         # 1. exchange ephemerals (plaintext)
         self._send_raw(eph_pub)
@@ -125,7 +190,17 @@ class SecretConnection:
             raise SecretConnectionError("remote echoed our ephemeral key")
 
         # 2. ECDH + key schedule
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        if use_pool:
+            shared = handshake_pool.exchange(eph_raw, remote_eph)
+            if shared == b"\x00" * 32:
+                # same contract as the reference/library exchange
+                raise ValueError(
+                    "X25519 exchange produced a low-order result"
+                )
+        else:
+            shared = eph_priv.exchange(
+                X25519PublicKey.from_public_bytes(remote_eph)
+            )
         send_key, recv_key, challenge = derive_secrets(
             shared, eph_pub, remote_eph
         )
@@ -149,23 +224,68 @@ class SecretConnection:
 
     # -- framed IO ---------------------------------------------------------
 
+    _MAX_SEALED = DATA_MAX_SIZE + 16 + 64  # data + AEAD tag + slack
+
     def write_frame(self, data: bytes) -> None:
-        sealed = self._send.seal(data)
-        self._send_raw(struct.pack(">I", len(sealed)) + sealed)
+        self.write_frames([data])
+
+    def write_frames(self, datas: "Sequence[bytes]") -> None:
+        """Seal a batch of frames (one coalesced AEAD pass when the plane
+        is active) and write them as ONE sendall — the wire bytes are
+        identical to per-frame writes, there are just fewer syscalls."""
+        if not datas:
+            return
+        sealed = self._send.seal_batch(list(datas))
+        self._send_raw(
+            b"".join(struct.pack(">I", len(s)) + s for s in sealed)
+        )
 
     def read_frame(self) -> bytes:
+        if self._plain:
+            return self._plain.popleft()
+        if self._recv_err is not None:
+            raise self._recv_err
         hdr = self._recv_exact(4)
         (n,) = struct.unpack(">I", hdr)
-        if n > DATA_MAX_SIZE + 16 + 64:  # data + AEAD tag + slack
+        if n > self._MAX_SEALED:
             raise SecretConnectionError(f"oversized frame {n}")
-        return self._recv.open(self._recv_exact(n))
+        frames = [self._recv_exact(n)]
+        # opportunistic batch: every COMPLETE frame already sitting in the
+        # receive buffer verifies in the same coalesced pass — a peer's
+        # send flush arrives as one TCP burst and decrypts as one dispatch
+        buf = self._recv_buf
+        while len(buf) >= 4:
+            (m,) = struct.unpack(">I", buf[:4])
+            if m > self._MAX_SEALED:
+                # deliver the frames before it first; the error surfaces
+                # at this frame's position, exactly like the serial path
+                self._recv_err = SecretConnectionError(
+                    f"oversized frame {m}"
+                )
+                break
+            if len(buf) < 4 + m:
+                break
+            frames.append(buf[4 : 4 + m])
+            buf = buf[4 + m :]
+        self._recv_buf = buf
+        pts, err = self._recv.open_batch(frames)
+        if err is not None:
+            self._recv_err = err
+        self._plain.extend(pts)
+        if not self._plain:
+            # first frame of the batch failed: raise now; the error stays
+            # sticky — past an auth failure the nonce stream is dead
+            raise self._recv_err
+        return self._plain.popleft()
 
     def write_msg(self, data: bytes) -> None:
         """Length-prefixed message spanning multiple frames (used for the
-        node-info handshake; MConnection does its own packetization)."""
-        self.write_frame(struct.pack(">I", len(data)))
+        node-info handshake; MConnection does its own packetization).
+        All chunks ride one coalesced write."""
+        frames = [struct.pack(">I", len(data))]
         for i in range(0, len(data), DATA_MAX_SIZE):
-            self.write_frame(data[i : i + DATA_MAX_SIZE])
+            frames.append(data[i : i + DATA_MAX_SIZE])
+        self.write_frames(frames)
 
     def read_msg(self, max_size: int = 1 << 20) -> bytes:
         hdr = self.read_frame()

@@ -1,0 +1,117 @@
+"""The transport plane front door: coalesced AEAD frames for p2p streams.
+
+``SecretConnection`` routes every batched seal/open through this module
+so there is exactly ONE seam between the wire format and the vectorized
+kernel (``ops/chacha_aead``) — the call-site lint
+(``scripts/check_aead_callsites.py``) pins it.  The plane owns the
+nonce-sequence convention (CometBFT's little-endian 64-bit counter in a
+96-bit nonce) and the batch/serial routing decision:
+
+  * ``batch_active(n)`` — True when ``COMETBFT_TPU_AEAD`` != 0 (default
+    on) and ``n`` reaches ``COMETBFT_TPU_AEAD_MIN_BATCH`` (default 4).
+    Below the threshold the caller keeps its per-frame serial path,
+    which is the bit-identical pre-plane code; ``COMETBFT_TPU_AEAD=0``
+    therefore restores pure-Python behavior everywhere at once.
+  * ``seal_frames(key, nonce_start, payloads)`` — one coalesced seal
+    pass over consecutive nonces; output frame i is byte-identical to
+    ``ChaCha20Poly1305Ref.encrypt(nonce(nonce_start+i), payload, b"")``.
+  * ``open_frames(key, nonce_start, sealed)`` — one coalesced verify
+    pass; returns the plaintext prefix up to (exclusive) the first
+    authentication failure plus that failure's index, so the caller
+    delivers exactly what the serial loop would have delivered before
+    raising.
+
+Tier faults live below this module (``ops/chacha_aead.aead_pass``
+degrades device → packed-numpy → pure reference); the plane never sees
+them — only definitive bytes and verdicts come back up.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional, Sequence
+
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.ops import chacha_aead
+from cometbft_tpu.p2p import transport_stats as tstats
+
+DEFAULT_MIN_BATCH = 4
+
+
+def enabled() -> bool:
+    return os.environ.get("COMETBFT_TPU_AEAD", "1") != "0"
+
+
+def min_batch() -> int:
+    try:
+        return max(
+            int(
+                os.environ.get("COMETBFT_TPU_AEAD_MIN_BATCH", "")
+                or DEFAULT_MIN_BATCH
+            ),
+            1,
+        )
+    except ValueError:
+        return DEFAULT_MIN_BATCH
+
+
+def batch_active(n: int) -> bool:
+    """Route ``n`` pending frames through the coalesced plane?  Singles
+    and tiny batches stay on the serial path: below the dispatch floor
+    there is nothing to amortize, and the serial path is the pre-plane
+    code verbatim."""
+    return enabled() and n >= min_batch()
+
+
+def nonce_bytes(counter: int) -> bytes:
+    """CometBFT SecretConnection nonce layout: LE64 counter + 4 zero
+    bytes = 96 bits."""
+    return struct.pack("<Q", counter) + b"\x00\x00\x00\x00"
+
+
+def seal_frames(
+    key: bytes, nonce_start: int, payloads: "Sequence[bytes]"
+) -> "list[bytes]":
+    """One coalesced seal over ``payloads`` at consecutive nonces
+    ``nonce_start..``; returns ``ciphertext||tag`` per frame,
+    byte-identical to the serial reference."""
+    frames = [
+        (key, nonce_bytes(nonce_start + i), bytes(p))
+        for i, p in enumerate(payloads)
+    ]
+    with tracing.span("aead.flush", op="seal", frames=len(frames)):
+        tstats.record_batch("seal")
+        tstats.record_frames("batched", len(frames))
+        return chacha_aead.seal_frames(frames)
+
+
+def open_frames(
+    key: bytes, nonce_start: int, sealed: "Sequence[bytes]"
+) -> "tuple[list[bytes], Optional[int]]":
+    """One coalesced verify+decrypt over ``sealed`` at consecutive
+    nonces.  Returns ``(plaintexts, bad_index)``: every frame before
+    ``bad_index`` authenticated and is delivered; ``bad_index`` is the
+    position of the first authentication failure (``None`` when all
+    frames verified).  Frames after a failure are withheld even if they
+    verified — the serial loop would never have reached them."""
+    frames = [
+        (key, nonce_bytes(nonce_start + i), bytes(c))
+        for i, c in enumerate(sealed)
+    ]
+    with tracing.span("aead.flush", op="open", frames=len(frames)):
+        tstats.record_batch("open")
+        tstats.record_frames("batched", len(frames))
+        pts = chacha_aead.open_frames(frames)
+    out: "list[bytes]" = []
+    for i, p in enumerate(pts):
+        if p is None:
+            return out, i
+        out.append(p)
+    return out, None
+
+
+def record_serial_frames(n: int) -> None:
+    """Serial-path accounting hook for callers below the batch threshold
+    (keeps the batched/serial routing ratio observable)."""
+    tstats.record_frames("serial", n)

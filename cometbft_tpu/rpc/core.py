@@ -14,6 +14,7 @@ from typing import Optional
 
 from cometbft_tpu.abci import types as at
 from cometbft_tpu.crypto import tmhash
+from cometbft_tpu.libs import storage_stats
 from cometbft_tpu.libs.pubsub import Query
 from cometbft_tpu.mempool.clist_mempool import MempoolError, TxInCacheError
 from cometbft_tpu.state.execution import fbr_from_json
@@ -165,6 +166,16 @@ class Environment:
     # -- info routes -------------------------------------------------------
 
     def health(self) -> dict:
+        # A fail-stop storage fatal means a persistent surface halted this
+        # node — liveness probes must see it (the HTTP server maps this
+        # error to 503 on the health route).
+        totals = storage_stats.snapshot()["totals"]
+        if totals["fatal"]:
+            raise RPCError(
+                -32000,
+                "node unhealthy: fail-stop storage fault",
+                data=f"fatals={totals['fatals']}",
+            )
         return {}
 
     def status(self) -> dict:

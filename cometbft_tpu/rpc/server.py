@@ -36,6 +36,19 @@ def _rpc_response(id_, result=None, error=None) -> bytes:
     return json.dumps(doc).encode()
 
 
+def _route_status(method, payload: bytes) -> int:
+    """HTTP status for a JSON-RPC reply.  Everything is 200 except the
+    health route, whose errors (fail-stop storage fault) must surface as
+    a 503 so liveness probes fail without parsing JSON-RPC envelopes."""
+    if method != "health":
+        return 200
+    try:
+        doc = json.loads(payload)
+    except ValueError:
+        return 200
+    return 503 if isinstance(doc, dict) and "error" in doc else 200
+
+
 def _event_to_json(msg) -> dict:
     """Render a pubsub Message (typed event data) for WS delivery."""
     from cometbft_tpu.types import events as tev
@@ -157,14 +170,15 @@ class RPCServer:
                     parts = [server._call_route_json(r) for r in req[: server.config.max_request_batch_size]]
                     self._send_json(b"[" + b",".join(parts) + b"]")
                     return
-                self._send_json(server._call_route_json(req))
+                payload = server._call_route_json(req)
+                method = req.get("method") if isinstance(req, dict) else None
+                self._send_json(payload, _route_status(method, payload))
 
             def _dispatch(self, name: str, params: dict, id_):
-                self._send_json(
-                    server._call_route_json(
-                        {"method": name, "params": params, "id": id_}
-                    )
+                payload = server._call_route_json(
+                    {"method": name, "params": params, "id": id_}
                 )
+                self._send_json(payload, _route_status(name, payload))
 
         self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
         self._httpd.daemon_threads = True

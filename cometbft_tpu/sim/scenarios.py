@@ -147,6 +147,9 @@ class ScenarioResult:
     # Merkle/hash-plane + proof-server counters captured at end-of-run
     # (light-stampede): queries/cache hits per kind, sheds, tree builds…
     proofs: dict = field(default_factory=dict)
+    # transport data-plane counters captured at end-of-run (dial-storm):
+    # frames per route, AEAD dispatch tiers, handshake pool/sync/shed…
+    transport: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -226,6 +229,20 @@ class ScenarioResult:
                     "trees_host",
                     "proof_cache_hit_rate",
                     "queries_per_flush",
+                )
+            }
+        if self.transport:
+            row["transport"] = {
+                k: self.transport[k]
+                for k in (
+                    "frames_total",
+                    "frames",
+                    "dispatches",
+                    "frames_per_batch",
+                    "bad_tags",
+                    "handshakes",
+                    "hs_shed",
+                    "handshakes_per_flush",
                 )
             }
         if self.spans:
@@ -348,6 +365,19 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_MERKLE_MIN_BATCH",
     "COMETBFT_TPU_MERKLE_DEVICE",
     "COMETBFT_TPU_MERKLE_MAX_LANES",
+    # encrypted transport data plane (transportplane + handshake_pool):
+    # dial-storm overrides these via extra_env; same save/restore
+    "COMETBFT_TPU_AEAD",
+    "COMETBFT_TPU_AEAD_DEVICE",
+    "COMETBFT_TPU_AEAD_MIN_BATCH",
+    "COMETBFT_TPU_AEAD_MAX_LANES",
+    "COMETBFT_TPU_HANDSHAKE",
+    "COMETBFT_TPU_HANDSHAKE_QUEUE",
+    "COMETBFT_TPU_HANDSHAKE_FLUSH_US",
+    "COMETBFT_TPU_HANDSHAKE_MAX_BATCH",
+    "COMETBFT_TPU_HANDSHAKE_TIMEOUT_S",
+    "COMETBFT_TPU_X25519_DEVICE",
+    "COMETBFT_TPU_X25519_MAX_LANES",
     # elastic mesh supervision (parallel/elastic): mesh scenarios force
     # membership + the shard runner in setup; these knobs ride the same
     # save/restore as everything else
@@ -1087,6 +1117,203 @@ def _light_stampede_teardown(cluster: SimCluster) -> None:
     proofserve.reset_server()
     proofserve.stats.reset()
     sha256_tree.clear_tree_runner()
+    _backend_faults_teardown(cluster)
+
+
+def _dial_storm(s: Scenario) -> list[Action]:
+    """Inbound-connection storm against the encrypted transport plane
+    mid-consensus (docs/transport-plane.md): scripted waves of 600
+    concurrent X25519 handshake admissions against a 256-slot pool queue
+    plus coalesced AEAD frame batches (sizes straddling the 64-byte
+    block edges, one deliberately tampered frame).  Shed handshakes fall
+    to the sync dial — never a dropped connection — and every count and
+    digest logged into the byte-compared trace is a function of the
+    seeded inputs and verdicts only, never of flush timing.  The final
+    wave re-runs a slice with both kill switches off and asserts the
+    bytes are identical: the plane is an optimization, not a cipher."""
+
+    def storm(c: SimCluster, wave: int) -> None:
+        import hashlib
+
+        from cometbft_tpu.crypto import aead_ref
+        from cometbft_tpu.ops import x25519_ladder
+        from cometbft_tpu.p2p import handshake_pool as hp
+        from cometbft_tpu.p2p import transport_stats as tstats
+        from cometbft_tpu.p2p import transportplane
+
+        # deterministic dial population: scalars and peer keys are pure
+        # functions of (wave, i) — the storm's trace bytes depend on
+        # nothing else
+        def scalar(i: int) -> bytes:
+            return hashlib.sha256(b"dial-storm-%d-%d" % (wave, i)).digest()
+
+        peer_pubs = [
+            aead_ref.x25519(
+                hashlib.sha256(b"dial-storm-peer-%d" % j).digest(),
+                x25519_ladder.BASE_U,
+            )
+            for j in range(8)
+        ]
+        pairs = [(scalar(i), peer_pubs[i % 8]) for i in range(600)]
+
+        # pause/resume brackets the burst so the overload is
+        # deterministic: the sim is single-threaded, so the dispatcher
+        # cannot drain mid-burst and exactly queue_cap dials are
+        # admitted; the rest shed to the sync ladder
+        pool = hp.get_pool()
+        futs = []
+        pool.pause()
+        try:
+            for p in pairs:
+                try:
+                    futs.append(pool.submit(*p))
+                except hp.QueueFullError:
+                    tstats.record_hs_shed()
+                    futs.append(None)
+        finally:
+            pool.resume()
+        digest = hashlib.sha256()
+        shed = 0
+        for f, p in zip(futs, pairs):
+            if f is None:
+                shed += 1
+                tstats.record_handshake("sync")
+                secret = hp.sync_exchange(*p)
+            else:
+                secret = f.result(timeout=30)
+                tstats.record_handshake("pool")
+            digest.update(secret)
+
+        # coalesced AEAD leg: one batch of frames straddling the 64-byte
+        # ChaCha block edges, with frame 25 tampered — the batch must
+        # deliver exactly the 25-frame prefix and reject the rest
+        key = hashlib.sha256(b"dial-storm-key-%d" % wave).digest()
+        sizes = (0, 1, 63, 64, 65, 100, 128, 500, 1021, 1024) * 4
+        payloads = [
+            hashlib.sha256(b"frame-%d-%d" % (wave, i)).digest() * 32
+            for i in range(len(sizes))
+        ]
+        payloads = [p[:n] for p, n in zip(payloads, sizes)]
+        sealed = transportplane.seal_frames(key, 0, payloads)
+        for ct in sealed:
+            digest.update(ct)
+        tampered = list(sealed)
+        tampered[25] = tampered[25][:-1] + bytes(
+            [tampered[25][-1] ^ 0x01]
+        )
+        pts, bad = transportplane.open_frames(key, 0, tampered)
+        assert bad == 25 and pts == payloads[:25], (
+            "tampered batch must deliver exactly the prefix before the "
+            "bad tag"
+        )
+        c._log(
+            "scenario: dial storm wave %d: 600 dials, %d shed, "
+            "aead frames=%d delivered=%d bad_at=%d digest=%s"
+            % (wave, shed, len(sealed), len(pts), bad,
+               digest.hexdigest()[:16])
+        )
+
+    def kill_switch_parity(c: SimCluster) -> None:
+        import hashlib
+
+        from cometbft_tpu.p2p import handshake_pool as hp
+        from cometbft_tpu.p2p import transportplane
+
+        # plane output for a slice of deterministic inputs...
+        key = hashlib.sha256(b"dial-storm-parity-key").digest()
+        payloads = [
+            hashlib.sha256(b"parity-%d" % i).digest() * 8 for i in range(8)
+        ]
+        scalars = [
+            hashlib.sha256(b"parity-scalar-%d" % i).digest()
+            for i in range(4)
+        ]
+        plane_sealed = transportplane.seal_frames(key, 0, payloads)
+        plane_secrets = [hp.public_key(s) for s in scalars]
+        # ...must be byte-identical with both kill switches off (the
+        # serial pure-Python path): the plane is an optimization, never
+        # a different cipher
+        saved = {
+            k: os.environ.get(k)
+            for k in ("COMETBFT_TPU_AEAD", "COMETBFT_TPU_HANDSHAKE")
+        }
+        os.environ["COMETBFT_TPU_AEAD"] = "0"
+        os.environ["COMETBFT_TPU_HANDSHAKE"] = "0"
+        try:
+            from cometbft_tpu.p2p.secret_connection import _HalfDuplex
+
+            hd = _HalfDuplex(key)
+            serial_sealed = [hd.seal(p) for p in payloads]
+            serial_secrets = [hp.public_key(s) for s in scalars]
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        assert plane_sealed == serial_sealed, (
+            "COMETBFT_TPU_AEAD=0 kill-switch parity broken"
+        )
+        assert plane_secrets == serial_secrets, (
+            "COMETBFT_TPU_HANDSHAKE=0 kill-switch parity broken"
+        )
+        c._log("scenario: dial-storm kill-switch parity ok (8 frames, 4 keys)")
+
+    return [
+        Action(float(t), "inbound dial storm (600 handshakes)",
+               lambda c, w=w: storm(c, w))
+        for w, t in enumerate((3, 5, 7))
+    ] + [
+        Action(9.0, "kill-switch parity check", kill_switch_parity)
+    ]
+
+
+def _dial_storm_setup():
+    base = _backend_faults_setup(
+        {
+            # verify scheduler ON so the run proves transport traffic
+            # cannot shed consensus-class verifies (different queues)
+            "COMETBFT_TPU_VERIFY_SCHED": "1",
+            "COMETBFT_TPU_AEAD": "1",
+            # sim batches are small: drop the min-batch gate so frame
+            # batches actually traverse the plane (the host-oracle
+            # runners below keep everything off real XLA)
+            "COMETBFT_TPU_AEAD_MIN_BATCH": "4",
+            "COMETBFT_TPU_HANDSHAKE": "1",
+            "COMETBFT_TPU_HANDSHAKE_QUEUE": "256",
+            "COMETBFT_TPU_HANDSHAKE_FLUSH_US": "500",
+            "COMETBFT_TPU_HANDSHAKE_MAX_BATCH": "128",
+        }
+    )
+
+    def setup(cluster: SimCluster) -> None:
+        base(cluster)
+        from cometbft_tpu.ops import chacha_aead, x25519_ladder
+        from cometbft_tpu.p2p import handshake_pool
+        from cometbft_tpu.p2p import transport_stats as tstats
+
+        # host-oracle runner seams: the pool/breaker/stats machinery
+        # above the seams runs unchanged, with no real XLA dispatch
+        # (mirrors _sim_device_runner); cleared in teardown
+        x25519_ladder.set_ladder_runner(x25519_ladder.host_ladder_runner)
+        chacha_aead.set_aead_runner(chacha_aead.host_aead_runner)
+        handshake_pool.reset_pool()
+        tstats.reset()
+
+    return setup
+
+
+def _dial_storm_teardown(cluster: SimCluster) -> None:
+    from cometbft_tpu.ops import chacha_aead, x25519_ladder
+    from cometbft_tpu.p2p import handshake_pool
+    from cometbft_tpu.p2p import transport_stats as tstats
+
+    # drain the pool BEFORE the env knobs flip back (its dispatcher must
+    # finish under the scenario's ladder runner)
+    handshake_pool.reset_pool()
+    tstats.reset()
+    x25519_ladder.clear_ladder_runner()
+    chacha_aead.clear_aead_runner()
     _backend_faults_teardown(cluster)
 
 
@@ -1850,6 +2077,26 @@ SCENARIOS: dict[str, Scenario] = {
             teardown=_light_stampede_teardown,
         ),
         Scenario(
+            "dial-storm",
+            "inbound-connection storm against the encrypted transport "
+            "plane: scripted 600-dial handshake waves against a 256-slot "
+            "pool queue mid-consensus plus coalesced AEAD frame batches "
+            "with a tampered frame, on the host-oracle ladder/AEAD "
+            "runner seams: shed dials fall to the sync ladder (never a "
+            "dropped connection), consensus-class verify shed stays 0 "
+            "by construction, the tampered batch delivers exactly the "
+            "prefix before the bad tag, traces stay byte-identical per "
+            "seed, and a final wave proves COMETBFT_TPU_AEAD=0 / "
+            "COMETBFT_TPU_HANDSHAKE=0 kill-switch byte parity.  Runs on "
+            "the host-oracle seams so tier-1 never pays real XLA "
+            "dispatches",
+            target_height=6,
+            max_time=180.0,
+            actions=_dial_storm,
+            setup=_dial_storm_setup(),
+            teardown=_dial_storm_teardown,
+        ),
+        Scenario(
             "tx-flood",
             "sustained scripted signed-tx bursts (valid/forged/malformed/"
             "oversize/duplicate mixes) from every peer against a 32-slot "
@@ -2197,6 +2444,12 @@ def run_scenario(
 
     _pstats.reset()
     proofs_counters: dict = {}
+    # transport-plane counters are per-run too (dial-storm): a soak row
+    # must reflect ITS run's frames and handshakes alone
+    from cometbft_tpu.p2p import transport_stats as _tpstats
+
+    _tpstats.reset()
+    transport_counters: dict = {}
     # disk-fault counters are per-run too: every scenario writes WALs
     # through the guard, and a soak row must reflect ITS run's IO alone
     from cometbft_tpu.libs import storage_stats as _ss
@@ -2258,6 +2511,11 @@ def run_scenario(
             "trees_host"
         ]:
             proofs_counters = psnap
+        # transport-plane counters (dial-storm): only when the plane or
+        # the handshake pool actually saw traffic this run
+        tpsnap = _tpstats.snapshot()
+        if tpsnap["frames_total"] or tpsnap["handshakes_total"]:
+            transport_counters = tpsnap
         # evidence-pool counters (dup-vote-flood / light-attack): only
         # when the pool actually saw traffic this run
         from cometbft_tpu.evidence import stats as evstats
@@ -2346,4 +2604,5 @@ def run_scenario(
         storage=storage_capture,
         fail_stopped=fail_stopped_capture,
         proofs=proofs_counters,
+        transport=transport_counters,
     )

@@ -1,0 +1,140 @@
+"""CI lint: keep future code on the encrypted transport plane.
+
+The transport plane (``cometbft_tpu/p2p/transportplane.py`` +
+``handshake_pool.py``, docs/transport-plane.md) only batches AEAD frames
+onto the device — and only coalesces X25519 handshake admission — if
+callers go through it.  A new subsystem that instantiates
+``ChaCha20Poly1305``/``ChaCha20Poly1305Ref`` or calls ``x25519`` /
+``X25519PrivateKey`` directly silently opts out of the lane-parallel
+kernels, the ``aead_device``/``x25519_device`` breakers and the
+dispatch accounting.  This gate fails on any DIRECT constructor or call
+site of those names in production code (``cometbft_tpu/``) outside:
+
+  * ``cometbft_tpu/crypto/``  — the primitives themselves plus the host
+    oracle every differential test compares against;
+  * ``cometbft_tpu/ops/``     — the device kernel layer (chacha_aead /
+    x25519_ladder host fallbacks and reference recomputes);
+
+plus a PINNED allowlist (each entry justified inline).  Growing a
+pinned file's call-site count — or adding one anywhere else — is a
+failure: new code seals/opens through ``transportplane`` and exchanges
+keys through ``handshake_pool``, which fall back to the serial
+primitives bit-for-bit below the min batch or when the plane is off.
+
+Usage (wired into tier-1 next to check_hash_callsites.py):
+    python scripts/check_aead_callsites.py [--repo-root PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import sys
+
+_SEAM_NAMES = frozenset(
+    (
+        "ChaCha20Poly1305",
+        "ChaCha20Poly1305Ref",
+        "X25519PrivateKey",
+        "X25519PrivateKeyRef",
+        "X25519PublicKey",
+        "X25519PublicKeyRef",
+        "x25519",
+    )
+)
+
+ALLOWED_DIRS = (
+    "cometbft_tpu/crypto",
+    "cometbft_tpu/ops",
+)
+ALLOWED_FILES = (
+    # The plane itself: its below-min-batch and kill-switch fallbacks ARE
+    # the sanctioned serial path.
+    "cometbft_tpu/p2p/transportplane.py",
+    "cometbft_tpu/p2p/handshake_pool.py",
+    # SecretConnection owns the serial fallback cipher and the legacy
+    # (pool-disabled) ephemeral-key path.
+    "cometbft_tpu/p2p/secret_connection.py",
+    # dial-storm builds deterministic peer public keys straight from the
+    # reference ladder so the scenario's inputs stay seed-stable even
+    # when the pool/plane under test is reconfigured.
+    "cometbft_tpu/sim/scenarios.py",
+)
+
+# Legacy direct call sites pinned at their current counts.  Empty today:
+# every production seal/open and ephemeral exchange already routes
+# through the plane/pool.  Anything that appears here later must carry
+# an inline justification.
+LEGACY_MAX: "dict[str, int]" = {}
+
+
+def _call_sites(source: str) -> "list[tuple[int, str]]":
+    """(lineno, call text) for every AST Call whose callee name is one of
+    the seam names — comments, docstrings and string literals can
+    mention the names freely without tripping the gate."""
+    hits = []
+    for node in ast.walk(ast.parse(source)):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.id
+            if isinstance(fn, ast.Name)
+            else fn.attr
+            if isinstance(fn, ast.Attribute)
+            else None
+        )
+        if name in _SEAM_NAMES:
+            hits.append((node.lineno, f"{name}(...)"))
+    return sorted(hits)
+
+
+def scan(repo_root: pathlib.Path) -> "list[str]":
+    """Return violation messages (empty = clean)."""
+    violations = []
+    pkg = repo_root / "cometbft_tpu"
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(repo_root).as_posix()
+        if any(
+            rel == d or rel.startswith(d + "/") for d in ALLOWED_DIRS
+        ) or rel in ALLOWED_FILES:
+            continue
+        try:
+            hits = _call_sites(path.read_text(errors="replace"))
+        except SyntaxError as e:
+            violations.append(f"{rel}: unparsable ({e}) — cannot lint")
+            continue
+        cap = LEGACY_MAX.get(rel, 0)
+        if len(hits) > cap:
+            for lineno, line in hits:
+                violations.append(f"{rel}:{lineno}: {line}")
+            violations.append(
+                f"{rel}: {len(hits)} direct AEAD/X25519 call site(s), "
+                f"allowed {cap} — route new work through "
+                "cometbft_tpu/p2p/transportplane + handshake_pool "
+                "(see docs/transport-plane.md)"
+            )
+    return violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo-root",
+        default=str(pathlib.Path(__file__).resolve().parent.parent),
+        help="repository root (default: this script's parent's parent)",
+    )
+    args = ap.parse_args(argv)
+    violations = scan(pathlib.Path(args.repo_root))
+    if violations:
+        print("aead-callsites: FAIL", file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print("aead-callsites: OK (all callers on the transport plane)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

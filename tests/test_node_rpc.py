@@ -8,6 +8,7 @@ broadcast_tx_commit and WebSocket NewBlock subscriptions.
 import base64
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -97,6 +98,47 @@ def test_status_and_blocks(node):
 
     abci = _rpc(port, "abci_info")
     assert int(abci["response"]["last_block_height"]) >= 1
+
+
+def test_health_503_on_storage_fatal(node):
+    """A fail-stop storage fatal flips GET /health (and the POST route)
+    to HTTP 503 so liveness probes fail without parsing JSON-RPC."""
+    from cometbft_tpu.libs import storage_stats
+
+    port = node.rpc_server.bound_port
+    _wait_height(node, 1)
+    url = f"http://127.0.0.1:{port}/health"
+    with urllib.request.urlopen(url, timeout=20) as resp:
+        assert resp.status == 200
+        assert json.loads(resp.read())["result"] == {}
+
+    storage_stats.record_fatal("wal")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=20)
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read())
+        assert "storage" in doc["error"]["message"]
+
+        # POST JSON-RPC health sees the same 503; other routes stay 200
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": "health", "params": {}}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            urllib.request.urlopen(req, timeout=20)
+        assert ei2.value.code == 503
+        st = _rpc(port, "status")
+        assert st["node_info"]["network"] == "rpc-test-chain"
+    finally:
+        storage_stats.reset()
+
+    with urllib.request.urlopen(url, timeout=20) as resp:
+        assert resp.status == 200
 
 
 def test_broadcast_tx_commit_roundtrip(node):

@@ -17,13 +17,15 @@ from cometbft_tpu.ops import warm_stats, warmboot
 
 @pytest.fixture(autouse=True)
 def _clean(monkeypatch):
-    # pin the secp/BLS/merkle extra matrices EMPTY for the legacy
-    # ed25519-matrix tests: their run() calls would otherwise really
-    # compile the ladder, G1 and tree kernels (~30s/shape on this host).
-    # TestExtraMatrix re-enables them against a monkeypatched warm seam.
+    # pin the secp/BLS/merkle/transport extra matrices EMPTY for the
+    # legacy ed25519-matrix tests: their run() calls would otherwise
+    # really compile the ladder, G1, tree and AEAD kernels (~30s/shape
+    # on this host).  TestExtraMatrix re-enables them against a
+    # monkeypatched warm seam.
     monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "")
     monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
     monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", "")
+    monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS", "")
     backend_health.reset()
     warmboot.reset()
     yield
@@ -223,6 +225,9 @@ class TestExtraMatrix:
         monkeypatch.delenv(
             "COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", raising=False
         )
+        monkeypatch.delenv(
+            "COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS", raising=False
+        )
         shapes = warmboot.extra_matrix()
         assert [
             s for br, f, s in shapes if f == "secp-ladder"
@@ -240,14 +245,34 @@ class TestExtraMatrix:
         assert {br for br, f, _ in shapes if f == "sha256-tree"} == {
             "merkle_device"
         }
+        # one env var feeds BOTH transport families: the AEAD and ladder
+        # kernels warm the same lane shapes, each behind its own breaker
+        assert [
+            s for br, f, s in shapes if f == "transport-aead"
+        ] == sorted(warmboot.DEFAULT_TRANSPORT_BUCKETS)
+        assert [
+            s for br, f, s in shapes if f == "transport-x25519"
+        ] == sorted(warmboot.DEFAULT_TRANSPORT_BUCKETS)
+        assert {br for br, f, _ in shapes if f == "transport-aead"} == {
+            "aead_device"
+        }
+        assert {br for br, f, _ in shapes if f == "transport-x25519"} == {
+            "x25519_device"
+        }
         # env override bounds each family; empty skips it entirely
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "4,2")
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "")
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_MERKLE_BUCKETS", "8,32")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS", "16")
         shapes = warmboot.extra_matrix()
         assert [s for _, f, s in shapes if f == "secp-ladder"] == [2, 4]
         assert not [s for _, f, s in shapes if f == "bls-g1"]
         assert [s for _, f, s in shapes if f == "sha256-tree"] == [8, 32]
+        assert [s for _, f, s in shapes if f == "transport-aead"] == [16]
+        assert [s for _, f, s in shapes if f == "transport-x25519"] == [16]
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS", "")
+        shapes = warmboot.extra_matrix()
+        assert not [s for _, f, s in shapes if f.startswith("transport-")]
 
     def _fake_exec(self, calls):
         def fake(backend, bucket, donated=None):
@@ -268,14 +293,19 @@ class TestExtraMatrix:
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BUCKETS", "32")
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_SECP_BUCKETS", "1,2")
         monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_BLS_BUCKETS", "4")
+        monkeypatch.setenv("COMETBFT_TPU_WARMBOOT_TRANSPORT_BUCKETS", "8")
         report = warmboot.run()
         assert ("secp-ladder", 1) in warmed
         assert ("secp-ladder", 2) in warmed
         assert ("bls-g1", 4) in warmed
+        assert ("transport-aead", 8) in warmed
+        assert ("transport-x25519", 8) in warmed
         assert report["statuses"]["secp-ladder-1"] == "hit"
         assert report["statuses"]["bls-g1-4"] == "hit"
+        assert report["statuses"]["transport-aead-8"] == "hit"
+        assert report["statuses"]["transport-x25519-8"] == "hit"
         # extra-family hits count toward the warmed total
-        assert report["warmed"] >= 4
+        assert report["warmed"] >= 6
 
     def test_extra_compile_failure_demotes_family_breaker(self, monkeypatch):
         def fake_extra(family, lanes):
