@@ -1741,6 +1741,119 @@ def run_diskfault(emit, n=128, seed=11) -> dict:
     return rec
 
 
+def run_blocksync(emit, seed=11) -> dict:
+    """Deterministic blocksync-under-faults stage (docs/sim-design.md
+    "WAN-grade blocksync").  Three legs, all on the virtual clock and
+    the host-oracle device seam (jax-free by construction):
+
+      * **storm leg** — the ``blocksync-storm`` scenario run TWICE with
+        the same seed: a late joiner catches 40+ heights through lossy
+        links while one helper goes mute, another serves a forged block
+        (ban -> half-open probe -> re-admission) and the joiner
+        crash-restarts mid-catchup.  Both runs' traces must be
+        byte-identical and the joiner must complete and promote.
+
+      * **wan leg** — the ``wan-catchup`` scenario once: a joiner
+        blocksyncs cross-region on the geo-cluster fabric while a
+        5-of-7 majority keeps committing through a geo-partition.
+
+      * **dispatch economics** — the fused-prefetch window must beat
+        per-height dispatching: dispatches-per-1k-synced-heights
+        strictly below 1000 (one dispatch per height is the serial
+        floor), asserted hard via the completion lines in the trace.
+
+    Emitted as stage="blocksync" and written to BENCH_BLOCKSYNC.json
+    for the bench_trend gate (walls advisory, counters hard)."""
+    import re as _re
+
+    from cometbft_tpu.sim.scenarios import run_scenario
+
+    t0 = time.perf_counter()
+    res_a = run_scenario("blocksync-storm", seed)
+    res_b = run_scenario("blocksync-storm", seed)
+    storm_wall = time.perf_counter() - t0
+
+    def _joiner_stats(res) -> dict:
+        out: dict = {}
+        for line in res.trace:
+            m = _re.search(
+                r"bsync node\d+ complete h=(\d+) dispatches=(\d+)", line
+            )
+            if m:
+                out = {"height": int(m.group(1)), "dispatches": int(m.group(2))}
+        return out
+
+    storm_join = _joiner_stats(res_a)
+    storm_bsync = res_a.bsync or {}
+    heights = storm_bsync.get("heights_synced", 0)
+    dispatches = storm_join.get("dispatches", 0)
+
+    t1 = time.perf_counter()
+    res_w = run_scenario("wan-catchup", seed)
+    wan_wall = time.perf_counter() - t1
+    wan_bsync = res_w.bsync or {}
+
+    rec = {
+        "metric": "blocksync_catchup",
+        "stage": "blocksync",
+        "seed": seed,
+        "storm_reached": bool(res_a.reached and res_b.reached),
+        "storm_violations": len(res_a.violations),
+        "storm_trace_identical": res_a.trace == res_b.trace,
+        "storm_joined": bool(storm_join),
+        "storm_heights_synced": heights,
+        "storm_requests": storm_bsync.get("requests", 0),
+        "storm_timeouts": storm_bsync.get("timeouts", 0),
+        "storm_bans": storm_bsync.get("bans", 0),
+        "storm_probe_passes": storm_bsync.get("probe_passes", 0),
+        "storm_redos": storm_bsync.get("redos", 0),
+        "prefetch_dispatches": dispatches,
+        "dispatches_per_1k_heights": (
+            round(dispatches * 1000.0 / heights, 3) if heights else 0.0
+        ),
+        "catchup_heights_per_s_virtual": round(
+            storm_bsync.get("heights_per_second", 0.0), 3
+        ),
+        "wan_reached": bool(res_w.reached),
+        "wan_violations": len(res_w.violations),
+        "wan_heights_synced": wan_bsync.get("heights_synced", 0),
+        "storm_wall_s": round(storm_wall, 3),
+        "wan_wall_s": round(wan_wall, 3),
+    }
+    emit(rec)
+    # hard invariants — catchup under WAN-grade faults must complete,
+    # replay byte-for-byte from the seed, and amortize verify dispatches
+    assert rec["storm_reached"] and rec["storm_violations"] == 0, (
+        res_a.violations or "storm did not reach target"
+    )
+    assert rec["storm_trace_identical"], (
+        "blocksync-storm traces diverged between same-seed runs"
+    )
+    assert rec["storm_joined"], "joiner never completed blocksync"
+    assert heights >= 40, f"joiner synced only {heights} heights"
+    assert rec["storm_bans"] >= 1 and rec["storm_probe_passes"] >= 1, (
+        "ban -> probe -> re-admission cycle never exercised"
+    )
+    assert dispatches >= 1, "fused-prefetch never dispatched"
+    assert rec["dispatches_per_1k_heights"] < 1000.0, (
+        "prefetch did not beat one-dispatch-per-height"
+    )
+    assert rec["wan_reached"] and rec["wan_violations"] == 0, (
+        res_w.violations or "wan-catchup did not reach target"
+    )
+    assert rec["wan_heights_synced"] >= 40, (
+        f"wan joiner synced only {rec['wan_heights_synced']} heights"
+    )
+    out = os.path.join(REPO, "BENCH_BLOCKSYNC.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    return rec
+
+
 def _loopback_cache_hit_rate() -> float:
     """Gossip-verify one round of precommits into a VoteSet, then re-verify
     the commit assembled from them (the apply-time LastCommit check) — the
@@ -2636,6 +2749,18 @@ def main() -> None:
         "BENCH_DISKFAULT_BATCH / _SEED size the run",
     )
     ap.add_argument(
+        "--blocksync",
+        action="store_true",
+        help="run only the blocksync-under-faults stage: the "
+        "blocksync-storm sim scenario twice with one seed (traces must "
+        "be byte-identical, the joiner must catch 40+ heights through "
+        "loss/mute/forgery/crash-restart with ban -> probe -> "
+        "re-admission exercised) plus one wan-catchup geo run; "
+        "fused-prefetch dispatches-per-1k-heights asserted hard below "
+        "the one-per-height floor; writes BENCH_BLOCKSYNC.json for the "
+        "bench_trend gate; BENCH_BLOCKSYNC_SEED sizes the run",
+    )
+    ap.add_argument(
         "--warmboot",
         action="store_true",
         help="run only the warm-boot pipeline stage: two cold processes "
@@ -2759,6 +2884,13 @@ def main() -> None:
             _emit,
             n=int(os.environ.get("BENCH_DISKFAULT_BATCH", "128")),
             seed=int(os.environ.get("BENCH_DISKFAULT_SEED", "11")),
+        )
+    elif args.blocksync:
+        # jax-free by construction (host-oracle device runner under the
+        # sim scenarios): no compilation cache plumbing needed
+        run_blocksync(
+            _emit,
+            seed=int(os.environ.get("BENCH_BLOCKSYNC_SEED", "11")),
         )
     elif args.warmboot:
         run_warmboot(_emit)

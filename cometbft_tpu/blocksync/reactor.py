@@ -36,8 +36,16 @@ _MSG_STATUS_REQUEST = 4
 _MSG_STATUS_RESPONSE = 5
 
 _STATUS_INTERVAL = 5.0
+# Faster cadence for switch peers the pool has no range for yet: a lost
+# StatusResponse otherwise blanks that peer's serving capacity for a full
+# _STATUS_INTERVAL (painful when it is the fastest helper).
+_STATUS_RETRY = 1.0
 _SWITCH_TO_CONSENSUS_INTERVAL = 1.0
 _POOL_TICK = 0.02
+
+# Never heard from ANY peer for this long after starting: assume a solo
+# chain / isolated node and run consensus (COMETBFT_TPU_BSYNC_SOLO_GRACE).
+_SOLO_GRACE = 10.0
 
 # Fused-verification window: how many frontier commits may share one device
 # dispatch (COMETBFT_TPU_BLOCKSYNC_WINDOW; <2 disables the prefetch).
@@ -49,6 +57,15 @@ def _window_k() -> int:
         return int(os.environ.get("COMETBFT_TPU_BLOCKSYNC_WINDOW", str(_DEFAULT_WINDOW)))
     except ValueError:
         return _DEFAULT_WINDOW
+
+
+def _solo_grace() -> float:
+    try:
+        return float(
+            os.environ.get("COMETBFT_TPU_BSYNC_SOLO_GRACE", str(_SOLO_GRACE))
+        )
+    except ValueError:
+        return _SOLO_GRACE
 
 
 def _enc(kind: int, body: bytes = b"") -> bytes:
@@ -66,6 +83,8 @@ class BlocksyncReactor(Reactor):
         consensus_reactor=None,  # for SwitchToConsensus
         enabled: bool = True,
         logger=None,
+        clock=None,  # injected seam (sim: virtual clock); wall monotonic default
+        rng=None,  # injected seam (sim: seeded Random) for the pool's choices
     ):
         super().__init__("BlocksyncReactor")
         self.state = state
@@ -74,10 +93,20 @@ class BlocksyncReactor(Reactor):
         self.consensus_reactor = consensus_reactor
         self.logger = logger or liblog.nop_logger()
         self.syncing = enabled
+        self._clock = clock if clock is not None else time.monotonic
+        self._solo_grace = _solo_grace()
         start = max(block_store.height() + 1, state.initial_height)
-        self.pool = BlockPool(start, self._send_block_request, self.logger)
+        self.pool = BlockPool(
+            start, self._send_block_request, self.logger, clock=clock, rng=rng
+        )
         self._thread: Optional[threading.Thread] = None
         self.synced_at: Optional[float] = None
+        # tick() pacing state: -inf so the first tick broadcasts status and
+        # runs the switch check immediately, as the wall-clock loop did
+        self._last_status = float("-inf")
+        self._last_status_retry = float("-inf")
+        self._status_req_at: Optional[float] = None
+        self._last_switch_check = float("-inf")
         # fused-prefetch memo: commit fingerprint -> height, so a window is
         # dispatched once and apply/redo ticks never re-dispatch it
         self._fused: dict[bytes, int] = {}
@@ -110,7 +139,7 @@ class BlocksyncReactor(Reactor):
         self.pool.height = max(
             self.block_store.height() + 1, state.last_block_height + 1
         )
-        self.pool._started_at = time.monotonic()
+        self.pool._started_at = self._clock()
         self.syncing = True
         self._start_pool()
 
@@ -119,6 +148,7 @@ class BlocksyncReactor(Reactor):
     def add_peer(self, peer) -> None:
         # announce our range + ask for theirs
         peer.try_send(BLOCKSYNC_CHANNEL, self._status_response())
+        self._status_req_at = self._clock()
         peer.try_send(BLOCKSYNC_CHANNEL, _enc(_MSG_STATUS_REQUEST))
 
     def remove_peer(self, peer, reason) -> None:
@@ -191,7 +221,14 @@ class BlocksyncReactor(Reactor):
             f = pe.fields_dict(body)
             height = pe.to_int64(f.get(1, [0])[-1])
             base = pe.to_int64(f.get(2, [0])[-1])
-            self.pool.set_peer_range(peer.id, base, height)
+            # the status handshake doubles as the RTT bootstrap: without
+            # it a new peer has no EWMA and falls back to the flat
+            # 15 s REQUEST_TIMEOUT — one dropped first response would
+            # wedge a frontier height for the full legacy timeout
+            rtt = None
+            if self._status_req_at is not None:
+                rtt = self._clock() - self._status_req_at
+            self.pool.set_peer_range(peer.id, base, height, rtt=rtt)
 
     # -- the sync loop (reference: reactor.go poolRoutine) -----------------
 
@@ -208,24 +245,46 @@ class BlocksyncReactor(Reactor):
         )
 
 
-    def _pool_routine(self) -> None:
-        last_status = 0.0
-        last_switch_check = 0.0
-        while self.is_running and self.syncing:
-            try:
-                now = time.monotonic()
-                if now - last_status > _STATUS_INTERVAL:
-                    last_status = now
-                    if self.switch is not None:
-                        self.switch.broadcast(
+    def tick(self) -> bool:
+        """One scheduler pass: periodic status broadcast, the
+        switch-to-consensus check, window refill, then the verify/apply
+        frontier.  Returns True when a block was processed (more frontier
+        work may be immediately available).  The wall-clock thread loop
+        wraps this; the deterministic sim drives it directly off the
+        virtual clock (sim/blocksync.py)."""
+        now = self._clock()
+        if now - self._last_status > _STATUS_INTERVAL:
+            self._last_status = now
+            if self.switch is not None:
+                self._status_req_at = now
+                self.switch.broadcast(
+                    BLOCKSYNC_CHANNEL, _enc(_MSG_STATUS_REQUEST)
+                )
+        elif now - self._last_status_retry > _STATUS_RETRY:
+            # re-ask only the peers whose range we still don't know: their
+            # StatusResponse (or our request) was lost in transit
+            self._last_status_retry = now
+            sw_peers = getattr(self.switch, "peers", None)
+            if sw_peers:
+                with self.pool._lock:
+                    known = set(self.pool.peers)
+                for p in list(sw_peers.values()):
+                    if p.id not in known:
+                        self._status_req_at = now
+                        p.try_send(
                             BLOCKSYNC_CHANNEL, _enc(_MSG_STATUS_REQUEST)
                         )
-                if now - last_switch_check > _SWITCH_TO_CONSENSUS_INTERVAL:
-                    last_switch_check = now
-                    if self._maybe_switch_to_consensus():
-                        return
-                self.pool.make_next_requests()
-                if not self._process_blocks():
+        if now - self._last_switch_check > _SWITCH_TO_CONSENSUS_INTERVAL:
+            self._last_switch_check = now
+            if self._maybe_switch_to_consensus():
+                return False
+        self.pool.make_next_requests()
+        return self._process_blocks()
+
+    def _pool_routine(self) -> None:
+        while self.is_running and self.syncing:
+            try:
+                if not self.tick():
                     time.sleep(_POOL_TICK)
             except Exception as e:  # noqa: BLE001
                 self.logger.error("blocksync pool error", err=repr(e))
@@ -445,7 +504,7 @@ class BlocksyncReactor(Reactor):
             # peer set mid-sync must NOT trigger this: reconnect will refill
             if (
                 not self.pool.ever_had_peers
-                and time.monotonic() - self.pool._started_at > 10.0
+                and self._clock() - self.pool._started_at > self._solo_grace
             ):
                 return self._switch()
             return False
@@ -453,7 +512,7 @@ class BlocksyncReactor(Reactor):
 
     def _switch(self) -> bool:
         self.syncing = False
-        self.synced_at = time.monotonic()
+        self.synced_at = self._clock()
         self.logger.info(
             "blocksync complete, switching to consensus",
             height=self.block_store.height(),

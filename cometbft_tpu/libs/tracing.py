@@ -1019,6 +1019,11 @@ def trace_document(
 
         return pstats.snapshot()
 
+    def _blocksync():
+        from cometbft_tpu.blocksync import stats as bstats
+
+        return bstats.snapshot()
+
     section("backend", _backend)
     section("sigcache", _sigcache)
     section("dispatch", _dispatch)
@@ -1029,4 +1034,5 @@ def trace_document(
     section("blackbox", _blackbox)
     section("storage", _storage)
     section("proofserve", _proofserve)
+    section("blocksync", _blocksync)
     return doc

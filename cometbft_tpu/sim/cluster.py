@@ -157,6 +157,7 @@ class SimCluster:
         self._started = False
         self._catchup = catchup
         self._joining = False  # statesync joins never nest
+        self._bsync: dict = {}  # in-flight blocksync joins (sim/blocksync.py)
 
     # -- assembly ----------------------------------------------------------
 
@@ -365,6 +366,9 @@ class SimCluster:
             self.active_node = None
 
     def stop(self) -> None:
+        for h in list(self._bsync.values()):
+            h.close()
+        self._bsync.clear()
         for node in self.live_nodes():
             node.cs.stop()
             node.app_conns.stop()
@@ -618,6 +622,66 @@ class SimCluster:
         self._drain_all()
         return True
 
+    def blocksync_join(self, i: int, helper_indices=None) -> bool:
+        """Bring node ``i`` online as a FRESH machine via BLOCKSYNC on the
+        virtual clock: the real ``BlocksyncReactor`` downloads every block
+        from live helpers through the faulty, bandwidth-shaped fabric,
+        verifies commits through the batch seam (fused-prefetch windows
+        included) and applies them; once caught up the cluster assembles a
+        full node over the populated stores and starts its consensus.
+        Returns False when no live helpers exist.  Non-blocking: the sync
+        runs on repeating clock timers inside the normal ``run`` loop
+        (``sim/blocksync.py``)."""
+        from cometbft_tpu.sim.blocksync import SimBlocksync
+
+        if self.nodes[i] is not None or i in self._bsync:
+            return False
+        helpers = [
+            n.index
+            for n in self.live_nodes()
+            if helper_indices is None or n.index in helper_indices
+        ]
+        if not helpers:
+            self._log("bsync node%d failed: no live peers" % i)
+            return False
+        fresh = self._dbs[i] is None
+        self._log(
+            "bsync node%d starting blocksync (%s) helpers=%s"
+            % (i, "fresh" if fresh else "resume", ",".join(map(str, helpers)))
+        )
+        self._bsync[i] = SimBlocksync(self, i, helpers)
+        return True
+
+    def blocksync_crash(self, i: int) -> None:
+        """Kill a mid-catchup blocksync joiner (its stores survive for a
+        ``blocksync_join`` resume — the crash-restart leg of the storm
+        scenario)."""
+        h = self._bsync.pop(i, None)
+        if h is not None:
+            h.crash()
+
+    def blocksync_harness(self, i: int):
+        """The live ``SimBlocksync`` for joiner ``i`` (fault-scripting
+        handle for scenario actions), or None."""
+        return self._bsync.get(i)
+
+    def _finish_blocksync_join(self, harness) -> None:
+        """Blocksync caught up: promote the joiner to a full member over
+        its populated stores (the blocksync analog of the tail of
+        ``_statesync_join``).  Runs inside a clock-timer callback, so the
+        surrounding ``step()`` drains and invariant-checks right after."""
+        i = harness.index
+        self._bsync.pop(i, None)
+        node = self._build(i, app=harness.app, app_conns=harness.conns)
+        self.nodes[i] = node
+        self.members.add(i)
+        self.checker.on_join(self, i, node.block_store.height())
+        self._log(
+            "join node%d blocksync complete h=%d"
+            % (i, node.block_store.height())
+        )
+        self._start_cs(node)
+
     def _statesync_sleeper(self, timeout: float) -> None:
         """The syncer's wait seam on virtual time: keep the REST of the
         cluster running (consensus timeouts, deliveries, scripted faults,
@@ -688,10 +752,12 @@ class SimCluster:
         if (
             timer.label
             and not timer.label.startswith("net ")
+            and not timer.label.startswith("bsync ")
             and timer.label != "catchup"
         ):
-            # deliveries log themselves with message detail; catchup ticks
-            # are pure scheduling noise
+            # deliveries log themselves with message detail; catchup and
+            # blocksync scheduler ticks are pure scheduling noise (the
+            # harness logs the semantic events itself)
             self._log("fire %s" % timer.label)
         self._drain_all()
         self.trace.extend(self.checker.on_event(self))

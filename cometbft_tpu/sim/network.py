@@ -31,12 +31,32 @@ class LinkConfig:
     dup_rate: float = 0.0
     reorder_rate: float = 0.0
     reorder_jitter: float = 0.25  # extra delay ceiling for reordered msgs
+    # Serialization rate: a payload of size_bytes adds size/bandwidth to
+    # the propagation delay (0 = infinite pipe, the pre-WAN behavior).
+    # Only senders that declare a payload size pay it — consensus gossip
+    # is small and modeled latency-only; blocksync block responses and
+    # statesync chunks are the big-payload callers (docs/sim-design.md).
+    bandwidth_bytes_per_s: float = 0.0
 
     def update(self, **overrides) -> None:
         for k, v in overrides.items():
             if not hasattr(self, k):
                 raise AttributeError(f"LinkConfig has no knob {k!r}")
             setattr(self, k, v)
+
+    def transfer_delay(self, size_bytes: int) -> float:
+        """Extra serialization delay for a payload of ``size_bytes``."""
+        if self.bandwidth_bytes_per_s <= 0.0 or size_bytes <= 0:
+            return 0.0
+        return size_bytes / self.bandwidth_bytes_per_s
+
+
+# Geo-cluster latency classes (one-way, seconds): intra-region links stay
+# LAN-ish; inter-region links get intercontinental spreads.  Values echo
+# the committee-consensus measurement regime (PAPERS.md, arXiv:2302.00418)
+# where geo-distribution, not crypto, dominates tail behavior.
+GEO_INTRA = {"delay_min": 0.002, "delay_max": 0.010}
+GEO_INTER = {"delay_min": 0.060, "delay_max": 0.180}
 
 
 @dataclass
@@ -64,6 +84,7 @@ class SimNetwork:
             if i != j
         }
         self._group_of: Optional[dict[int, int]] = None  # node -> group id
+        self._regions: list[list[int]] = []  # set by set_geo_clusters
         self.deliver_fn: Optional[
             Callable[[int, int, object, object], None]
         ] = None
@@ -86,6 +107,43 @@ class SimNetwork:
         for (src, dst), cfg in self.links.items():
             if src == i or dst == i:
                 cfg.update(**overrides)
+
+    def set_geo_clusters(
+        self,
+        regions: "list[list[int]]",
+        intra: Optional[dict] = None,
+        inter: Optional[dict] = None,
+        **extra,
+    ) -> None:
+        """Shape the fabric as geo-clusters: every link inside one region
+        gets the ``intra`` latency class (default ``GEO_INTRA``), every
+        cross-region link the ``inter`` class (default ``GEO_INTER``).
+        ``extra`` knobs (drop/bandwidth/...) apply to ALL links on top.
+        Nodes not named in any region form one implicit remainder region.
+        Composes with ``partition``/``geo_partition``: latency classes
+        shape live links, partitions cut them."""
+        self._regions = [list(r) for r in regions]
+        region_of: dict[int, int] = {}
+        for rid, region in enumerate(self._regions):
+            for i in region:
+                region_of[i] = rid
+        for i in range(self.n):
+            region_of.setdefault(i, len(self._regions))
+        intra = dict(GEO_INTRA if intra is None else intra)
+        inter = dict(GEO_INTER if inter is None else inter)
+        for (src, dst), cfg in self.links.items():
+            cls = intra if region_of[src] == region_of[dst] else inter
+            cfg.update(**cls)
+            if extra:
+                cfg.update(**extra)
+
+    def geo_partition(self, *cut_regions: int) -> None:
+        """Cut the named regions (indices into the ``set_geo_clusters``
+        list) off from the rest of the world — each cut region becomes its
+        own partition group; everything else stays one group."""
+        if not getattr(self, "_regions", None):
+            raise RuntimeError("geo_partition requires set_geo_clusters first")
+        self.partition(*[self._regions[r] for r in cut_regions])
 
     def partition(self, *groups: list[int]) -> None:
         """Split the cluster into the given groups; nodes not named form one
@@ -119,11 +177,17 @@ class SimNetwork:
                 continue
             self._schedule(src, dst, msg, ctx)
 
-    def unicast(self, src: int, dst: int, msg: object, ctx=None) -> None:
-        """Point-to-point send through the same faulty link (catchup)."""
-        self._schedule(src, dst, msg, ctx)
+    def unicast(
+        self, src: int, dst: int, msg: object, ctx=None, size_bytes: int = 0
+    ) -> None:
+        """Point-to-point send through the same faulty link (catchup).
+        ``size_bytes`` > 0 adds serialization delay on bandwidth-shaped
+        links (``LinkConfig.bandwidth_bytes_per_s``)."""
+        self._schedule(src, dst, msg, ctx, size_bytes=size_bytes)
 
-    def _schedule(self, src: int, dst: int, msg: object, ctx=None) -> None:
+    def _schedule(
+        self, src: int, dst: int, msg: object, ctx=None, size_bytes: int = 0
+    ) -> None:
         cfg = self.links[(src, dst)]
         self.stats.sent += 1
         if not self.connected(src, dst):
@@ -136,8 +200,9 @@ class SimNetwork:
         if cfg.dup_rate > 0.0 and self.rng.random() < cfg.dup_rate:
             copies = 2
             self.stats.duplicated += 1
+        xfer = cfg.transfer_delay(size_bytes)
         for _ in range(copies):
-            delay = self.rng.uniform(cfg.delay_min, cfg.delay_max)
+            delay = self.rng.uniform(cfg.delay_min, cfg.delay_max) + xfer
             if cfg.reorder_rate > 0.0 and self.rng.random() < cfg.reorder_rate:
                 delay += self.rng.uniform(0.0, cfg.reorder_jitter)
             self.clock.call_later(
@@ -147,7 +212,12 @@ class SimNetwork:
             )
 
     def schedule_transfer(
-        self, src: int, dst: int, fn: Callable[[], None], label: str = "xfer"
+        self,
+        src: int,
+        dst: int,
+        fn: Callable[[], None],
+        label: str = "xfer",
+        size_bytes: int = 0,
     ) -> bool:
         """Schedule an arbitrary point-to-point delivery callback through
         the same faulty link as consensus traffic (delay/drop/partition;
@@ -166,7 +236,9 @@ class SimNetwork:
         if cfg.drop_rate > 0.0 and self.rng.random() < cfg.drop_rate:
             self.stats.dropped_rate += 1
             return False
-        delay = self.rng.uniform(cfg.delay_min, cfg.delay_max)
+        delay = self.rng.uniform(cfg.delay_min, cfg.delay_max) + cfg.transfer_delay(
+            size_bytes
+        )
         if cfg.reorder_rate > 0.0 and self.rng.random() < cfg.reorder_rate:
             delay += self.rng.uniform(0.0, cfg.reorder_jitter)
 

@@ -150,6 +150,10 @@ class ScenarioResult:
     # transport data-plane counters captured at end-of-run (dial-storm):
     # frames per route, AEAD dispatch tiers, handshake pool/sync/shed…
     transport: dict = field(default_factory=dict)
+    # blocksync catchup counters captured at end-of-run (blocksync-storm,
+    # wan-catchup): requests/timeouts/bans/probes/redos/stall-switches,
+    # heights synced and the virtual-time catchup rate
+    bsync: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         """JSON-serializable row for soak artifacts (scripts/sim_soak.py)."""
@@ -243,6 +247,22 @@ class ScenarioResult:
                     "handshakes",
                     "hs_shed",
                     "handshakes_per_flush",
+                )
+            }
+        if self.bsync:
+            row["bsync"] = {
+                k: self.bsync[k]
+                for k in (
+                    "requests",
+                    "timeouts",
+                    "bans",
+                    "probes",
+                    "probe_passes",
+                    "redos",
+                    "stall_switches",
+                    "blocks_received",
+                    "heights_synced",
+                    "heights_per_second",
                 )
             }
         if self.spans:
@@ -385,6 +405,19 @@ _BACKEND_ENV_KNOBS = (
     "COMETBFT_TPU_MESH",
     "COMETBFT_TPU_MESH_MIN_BATCH",
     "COMETBFT_TPU_WARMBOOT_MESH_SHRINK",
+    # blocksync adaptive catchup (blocksync/pool.py + reactor.py): the
+    # WAN scenarios pin BAN_BASE/STALL_SECS via extra_env so ban/probe
+    # cycles fit inside a catchup window; same save/restore as the rest
+    "COMETBFT_TPU_BSYNC_ADAPTIVE",
+    "COMETBFT_TPU_BSYNC_TIMEOUT_MULT",
+    "COMETBFT_TPU_BSYNC_TIMEOUT_FLOOR",
+    "COMETBFT_TPU_BSYNC_TIMEOUT_CAP",
+    "COMETBFT_TPU_BSYNC_BAN_BASE",
+    "COMETBFT_TPU_BSYNC_BAN_CAP",
+    "COMETBFT_TPU_BSYNC_BAN_STRIKES",
+    "COMETBFT_TPU_BSYNC_STALL_SECS",
+    "COMETBFT_TPU_BSYNC_SOLO_GRACE",
+    "COMETBFT_TPU_BLOCKSYNC_WINDOW",
     # observability knobs: saved/restored for cross-run hygiene only.
     # NOTE the cluster reads the BLACKBOX knobs at construction — before
     # setup hooks run — so a scenario override affects only journals
@@ -1595,6 +1628,116 @@ def _statesync_storm(s: Scenario) -> list[Action]:
     ]
 
 
+# -- blocksync catchup scenarios ---------------------------------------------
+
+
+def _retrying_bsync_join(
+    c: SimCluster, idx: int, attempt: int = 0, max_attempts: int = 10
+) -> None:
+    """Blocksync-join ``idx``, retrying every 2 virtual seconds while no
+    live helper exists (or a previous join is still in flight).  All
+    retries ride the scripted clock, so the dance replays from the seed."""
+    if c.nodes[idx] is not None:
+        return
+    if not c.blocksync_join(idx) and attempt + 1 < max_attempts:
+        c.clock.call_later(
+            2.0,
+            lambda: _retrying_bsync_join(c, idx, attempt + 1, max_attempts),
+            label=f"scenario bsync-retry node{idx}",
+        )
+
+
+def _bsync_fault(j: int, method: str, src: int, on: bool):
+    """Action body toggling a fault hook on joiner ``j``'s live harness —
+    a no-op once the joiner has promoted (the harness is gone), so late
+    scripted toggles can't crash a run that synced faster than scripted."""
+
+    def act(c: SimCluster) -> None:
+        h = c.blocksync_harness(j)
+        if h is not None:
+            getattr(h, method)(src, on)
+
+    return act
+
+
+def _blocksync_storm(s: Scenario) -> list[Action]:
+    """A late joiner blocksyncs 40+ heights through lossy high-latency
+    links while the helper set misbehaves: node1 goes mute mid-window
+    (adaptive RTT timeouts fire and its requests re-assign), node2 serves
+    forged block bodies (validate_block redo + exponential ban, then a
+    half-open probe re-admits it once clean), and the joiner itself
+    crash-restarts mid-catchup and resumes from its surviving stores."""
+    j = s.n_vals
+
+    def shape(c: SimCluster) -> None:
+        c.net.set_node_links(
+            j,
+            delay_min=0.25,
+            delay_max=0.7,
+            drop_rate=0.2,
+            bandwidth_bytes_per_s=65536.0,
+        )
+
+    return [
+        Action(0.0, "WAN-grade loss/latency on the joiner's links", shape),
+        Action(45.0, f"node{j} joins via blocksync (lossy)",
+               lambda c: _retrying_bsync_join(c, j)),
+        Action(47.0, "mute helper node1 (stall mid-window)",
+               _bsync_fault(j, "set_mute", 1, True)),
+        Action(48.0, "helper node2 starts forging block bodies",
+               _bsync_fault(j, "set_tamper", 2, True)),
+        Action(49.5, f"crash joiner node{j} mid-catchup",
+               lambda c: c.blocksync_crash(j)),
+        Action(50.5, f"node{j} resumes blocksync from its stores",
+               lambda c: _retrying_bsync_join(c, j)),
+        # the fresh pool's first volley lands on a forging node2: the
+        # forged bodies sit just ahead of the low frontier, so the
+        # validate-redo ban fires mid-storm and the half-open probe (and
+        # re-admission) play out while the catchup is still running
+        Action(50.6, "helper node2 forges again (post-restart)",
+               _bsync_fault(j, "set_tamper", 2, True)),
+        Action(53.0, "helper node2 behaves again",
+               _bsync_fault(j, "set_tamper", 2, False)),
+        Action(53.5, "mute helper node1 again (post-restart)",
+               _bsync_fault(j, "set_mute", 1, True)),
+        Action(58.0, "unmute helper node1",
+               _bsync_fault(j, "set_mute", 1, False)),
+        # the storm passes: with ~1.5 s lossy RTT against a ~1 s block
+        # interval the head-chase can hover forever; clean links let the
+        # joiner converge and switch to consensus
+        Action(60.0, "storm passes: joiner links recover",
+               lambda c, j=j: c.net.set_node_links(
+                   j, delay_min=0.01, delay_max=0.05, drop_rate=0.0,
+                   bandwidth_bytes_per_s=0.0)),
+    ]
+
+
+def _wan_catchup(s: Scenario) -> list[Action]:
+    """3-region geo topology: a joiner in region 2 blocksyncs cross-region
+    while consensus continues; mid-sync its whole region is geo-partitioned
+    off (the 5-of-7 majority keeps committing — cutting the region costs
+    only 2 validators — while the joiner drains its frozen intra-region
+    helpers) and after heal it catches the moving head."""
+    j = s.n_vals
+    regions = [[0, 1, 2], [3, 4], [5, 6, j]]
+
+    def shape(c: SimCluster) -> None:
+        c.net.set_geo_clusters(regions, bandwidth_bytes_per_s=262144.0)
+
+    return [
+        Action(0.0, "geo-cluster fabric: 3 regions, bandwidth-shaped",
+               shape),
+        Action(62.0, f"node{j} joins via blocksync cross-region",
+               lambda c: _retrying_bsync_join(c, j)),
+        # mid-volley: cross-region requests in flight are yanked with the
+        # cable, time out on the adaptive schedule and re-assign to the
+        # joiner's (frozen) intra-region helpers
+        Action(62.3, "geo-partition the joiner's region off",
+               lambda c: c.net.geo_partition(2)),
+        Action(68.0, "heal the geo-partition", lambda c: c.net.heal()),
+    ]
+
+
 # -- adversarial evidence scenarios ------------------------------------------
 
 
@@ -2354,6 +2497,60 @@ SCENARIOS: dict[str, Scenario] = {
             ),
             teardown=_backend_faults_teardown,
         ),
+        Scenario(
+            "blocksync-storm",
+            "a late joiner blocksyncs 40+ heights through lossy "
+            "high-latency links while the helper set misbehaves: node1 "
+            "goes mute mid-window (adaptive RTT timeouts fire and its "
+            "requests re-assign), node2 serves forged block bodies "
+            "(validate_block redo + exponential ban, half-open probe "
+            "re-admits it once clean), and the joiner crash-restarts "
+            "mid-catchup and resumes from its surviving stores.  Commit "
+            "verification rides the fused-prefetch dispatch windows; "
+            "the whole dance is byte-deterministic per seed",
+            target_height=75,
+            max_time=420.0,
+            n_spares=1,
+            actions=_blocksync_storm,
+            setup=_backend_faults_setup(
+                {
+                    "COMETBFT_TPU_SIGCACHE": "1",
+                    # short ban base + stall window + tight timeout mult
+                    # so the exponential ban -> half-open probe ->
+                    # re-admission cycle and a stall-switch all land
+                    # INSIDE the catchup window (at mult 4 a dropped
+                    # frontier response costs ~5 s — two in a row and the
+                    # frontier never reaches the forged heights)
+                    "COMETBFT_TPU_BSYNC_BAN_BASE": "2.0",
+                    "COMETBFT_TPU_BSYNC_STALL_SECS": "3.0",
+                    "COMETBFT_TPU_BSYNC_TIMEOUT_MULT": "2.0",
+                }
+            ),
+            teardown=_backend_faults_teardown,
+        ),
+        Scenario(
+            "wan-catchup",
+            "3-region geo topology (intra 2-10ms, inter 60-180ms "
+            "one-way, bandwidth-shaped links): a joiner in region 2 "
+            "blocksyncs 40+ heights cross-region while consensus "
+            "continues; mid-sync its whole region is geo-partitioned "
+            "off — the 5-of-7 majority keeps committing, the joiner "
+            "drains its frozen intra-region helpers and stalls — and "
+            "after heal it catches the head and promotes.  Per-round "
+            "quorum timelines land in the flight-recorder rounds report",
+            n_vals=7,
+            target_height=60,
+            max_time=420.0,
+            n_spares=1,
+            actions=_wan_catchup,
+            setup=_backend_faults_setup(
+                {
+                    "COMETBFT_TPU_SIGCACHE": "1",
+                    "COMETBFT_TPU_BSYNC_BAN_BASE": "2.0",
+                }
+            ),
+            teardown=_backend_faults_teardown,
+        ),
     ]
 }
 
@@ -2450,6 +2647,12 @@ def run_scenario(
 
     _tpstats.reset()
     transport_counters: dict = {}
+    # blocksync catchup counters are per-run too (blocksync-storm /
+    # wan-catchup): a soak row must reflect ITS run's catchup alone
+    from cometbft_tpu.blocksync import stats as _bstats
+
+    _bstats.reset()
+    bsync_counters: dict = {}
     # disk-fault counters are per-run too: every scenario writes WALs
     # through the guard, and a soak row must reflect ITS run's IO alone
     from cometbft_tpu.libs import storage_stats as _ss
@@ -2516,6 +2719,11 @@ def run_scenario(
         tpsnap = _tpstats.snapshot()
         if tpsnap["frames_total"] or tpsnap["handshakes_total"]:
             transport_counters = tpsnap
+        # blocksync catchup counters (blocksync-storm / wan-catchup):
+        # only when a joiner actually catch-up-synced this run
+        bsnap = _bstats.snapshot()
+        if bsnap["requests"] or bsnap["blocks_received"]:
+            bsync_counters = bsnap
         # evidence-pool counters (dup-vote-flood / light-attack): only
         # when the pool actually saw traffic this run
         from cometbft_tpu.evidence import stats as evstats
@@ -2605,4 +2813,5 @@ def run_scenario(
         fail_stopped=fail_stopped_capture,
         proofs=proofs_counters,
         transport=transport_counters,
+        bsync=bsync_counters,
     )

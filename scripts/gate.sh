@@ -21,7 +21,7 @@ cd "$(dirname "$0")/.."
 # gate run compiled, instead of re-tracing per process.
 export COMETBFT_TPU_EXEC_CACHE="${COMETBFT_TPU_EXEC_CACHE:-$PWD/.exec_cache}"
 
-echo "== gate 1/12: verify/hash/aead call-site + disk-policy lints =="
+echo "== gate 1/13: verify/hash/aead call-site + disk-policy lints =="
 python scripts/check_verify_callsites.py
 # new direct merkle call sites must use the proofserve plane seam
 python scripts/check_hash_callsites.py
@@ -30,21 +30,21 @@ python scripts/check_aead_callsites.py
 # new direct open/fsync/replace call sites must use the diskguard seam
 python scripts/check_diskpolicy.py
 
-echo "== gate 2/12: pytest =="
+echo "== gate 2/13: pytest =="
 rm -f /tmp/_gate_t1.log
 python -m pytest tests/ -x -q --durations=40 2>&1 | tee /tmp/_gate_t1.log
 python scripts/check_tier1_budget.py /tmp/_gate_t1.log
 
-echo "== gate 3/12: bench.py =="
+echo "== gate 3/13: bench.py =="
 python bench.py
 
-echo "== gate 4/12: bench.py --meshfault (elastic mesh fault isolation) =="
+echo "== gate 4/13: bench.py --meshfault (elastic mesh fault isolation) =="
 # healthy vs one-dead-chip dispatch on the per-shard host-oracle seam:
 # verdict equality, exactly one shrink, dispatch counts asserted hard;
 # refreshes BENCH_MESHFAULT.json for the trend gate below
 JAX_PLATFORMS=cpu python bench.py --meshfault
 
-echo "== gate 5/12: disk-fault robustness (diskguard) =="
+echo "== gate 5/13: disk-fault robustness (diskguard) =="
 # the three storage scenarios (fail-stop halt / degrade-with-retries /
 # torn-tail repair) with invariants raised to hard failures, then the
 # bench stage: verdict equality under injected faults + same-seed trace
@@ -59,7 +59,7 @@ for name in ('disk-full', 'disk-brownout', 'torn-wal-restart'):
 "
 JAX_PLATFORMS=cpu python bench.py --diskfault
 
-echo "== gate 6/12: proof plane (light-stampede + bench.py --proofserve) =="
+echo "== gate 6/13: proof plane (light-stampede + bench.py --proofserve) =="
 # thousands of light-client proof queries mid-consensus on the host
 # tree-runner seam: zero consensus-class verify shed, commits reach the
 # target, byte-deterministic per seed (invariants raised to hard
@@ -77,7 +77,7 @@ print('light-stampede ok heights=%s proofs=%s' % (r.heights, r.proofs))
 "
 JAX_PLATFORMS=cpu python bench.py --proofserve
 
-echo "== gate 7/12: transport plane (dial-storm + bench.py --transport) =="
+echo "== gate 7/13: transport plane (dial-storm + bench.py --transport) =="
 # hundreds of concurrent inbound dials mid-consensus on the host AEAD +
 # ladder runner seams: handshake queue sheds only to the sync dial (zero
 # consensus-class verify shed), frame batches authenticate with the
@@ -101,7 +101,7 @@ print('dial-storm ok heights=%s transport=%s' % (r.heights, r.transport))
 "
 JAX_PLATFORMS=cpu python bench.py --transport
 
-echo "== gate 8/12: bench.py --multichip (in-flight verify pipeline) =="
+echo "== gate 8/13: bench.py --multichip (in-flight verify pipeline) =="
 # the 10240-sig commit shape chunked over an 8-lane virtual mesh with K
 # dispatches in flight on the host-oracle shard seam: oracle-equal
 # verdicts, full in-flight occupancy and lane coverage asserted hard
@@ -110,26 +110,47 @@ echo "== gate 8/12: bench.py --multichip (in-flight verify pipeline) =="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python bench.py --multichip
 
-echo "== gate 9/12: bench trend (BENCH_HISTORY.jsonl) =="
+echo "== gate 9/13: blocksync catchup plane (storm + WAN + bench) =="
+# a late joiner catches 40+ heights through lossy bandwidth-shaped links
+# while helpers stall/forge (adaptive timeouts, strike bans, half-open
+# probe re-admission, stall switch), and a geo-clustered joiner syncs
+# cross-region through a mid-sync partition: both byte-deterministic per
+# seed including every pool counter; then the bench stage asserts the
+# ban->probe->re-admission cycle and the fused-prefetch dispatch budget;
+# refreshes BENCH_BLOCKSYNC.json for the trend gate below
+JAX_PLATFORMS=cpu python -c "
+from cometbft_tpu.sim.scenarios import run_scenario
+for name in ('blocksync-storm', 'wan-catchup'):
+    r = run_scenario(name, 3, raise_on_violation=True)
+    assert r.reached, (name, r.heights)
+    assert r.bsync.get('heights_synced', 0) >= 40, (name, r.bsync)
+    r2 = run_scenario(name, 3, raise_on_violation=True)
+    assert r.trace == r2.trace, '%s trace diverged between runs' % name
+    assert r.bsync == r2.bsync, (r.bsync, r2.bsync)
+    print('%-16s ok heights=%s bsync=%s' % (name, r.heights, r.bsync))
+"
+JAX_PLATFORMS=cpu python bench.py --blocksync
+
+echo "== gate 10/13: bench trend (BENCH_HISTORY.jsonl) =="
 # re-ingests every BENCH_*.json + sim_soak trend JSON and fails on hard
 # regressions (dispatch counts, cache/occupancy ratios) beyond the noise
 # band; wall/throughput deltas stay advisory on this throttled host
 python scripts/bench_trend.py --check
 
-echo "== gate 10/12: SIGKILL forensics (black-box postmortem) =="
+echo "== gate 11/13: SIGKILL forensics (black-box postmortem) =="
 # crash a sim validator mid-round, decode its journal with the real
 # `cometbft-tpu postmortem --json` subprocess, assert the reconstructed
 # in-flight round + dispatch attribution, byte-deterministic per seed
 JAX_PLATFORMS=cpu python scripts/check_postmortem.py
 
-echo "== gate 11/12: dryrun_multichip(8) + elastic fault leg =="
+echo "== gate 12/13: dryrun_multichip(8) + elastic fault leg =="
 # includes the chip-death leg: one ordinal killed mid-run, the batch
 # must re-verify on the shrunken mesh with correct ordinal attribution
 # (COMETBFT_TPU_DRYRUN_FAULT=0 skips the leg)
 XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== gate 12/12: native sanitizers (TSAN+ASAN) =="
+echo "== gate 13/13: native sanitizers (TSAN+ASAN) =="
 bash scripts/sanitize_native.sh
 
 if [ "${NIGHTLY:-0}" = "1" ]; then

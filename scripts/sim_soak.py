@@ -90,6 +90,25 @@ def _row_extra(row: dict) -> str:
             proofs.get("trees_device", 0),
             proofs.get("trees_device", 0) + proofs.get("trees_host", 0),
         )
+    bsync = row.get("bsync") or {}
+    if bsync:
+        # blocksync-storm / wan-catchup: catchup discipline at a glance —
+        # heights synced, request/timeout volume, ban -> probe -> readmit
+        # cycling, stall switches and the virtual-clock sync rate
+        extra += (
+            " bsync[h=%d req=%d to=%d ban=%d probe=%d/%d stall=%d "
+            "hps=%.1f]"
+            % (
+                bsync.get("heights_synced", 0),
+                bsync.get("requests", 0),
+                bsync.get("timeouts", 0),
+                bsync.get("bans", 0),
+                bsync.get("probe_passes", 0),
+                bsync.get("probes", 0),
+                bsync.get("stall_switches", 0),
+                bsync.get("heights_per_second", 0.0),
+            )
+        )
     evidence = row.get("evidence") or {}
     if evidence:
         # evidence scenarios: pool discipline under flood

@@ -1,0 +1,338 @@
+"""BlockPool adaptive-scheduling unit tests on a fake clock.
+
+Every WAN-hardening behavior the pool grew is pinned here without a
+cluster or network in sight: adaptive per-peer timeouts off the RTT EWMA
+(seeded by the status handshake), strike-based bans with exponential
+backoff and same-incident coalescing, half-open probe re-admission, the
+frontier stall-switch, pending-count sanity, and the
+``COMETBFT_TPU_BSYNC_ADAPTIVE=0`` kill switch restoring the legacy flat
+timeout / flat ban schedule.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu.blocksync import stats as bstats
+from cometbft_tpu.blocksync.pool import (
+    PEER_PENDING_CAP,
+    REQUEST_TIMEOUT,
+    REQUEST_WINDOW,
+    BlockPool,
+    PoolConfig,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _Header:
+    def __init__(self, height: int):
+        self.height = height
+
+
+class _Block:
+    def __init__(self, height: int):
+        self.header = _Header(height)
+
+
+CFG = dict(
+    adaptive=True,
+    timeout_mult=4.0,
+    timeout_floor=2.0,
+    timeout_cap=30.0,
+    ban_base=2.0,
+    ban_cap=16.0,
+    ban_strikes=3,
+    stall_secs=5.0,
+)
+
+
+def make_pool(clock, start=1, config=None, send=None):
+    sent: list[tuple[str, int]] = []
+
+    def _send(peer_id: str, h: int) -> bool:
+        sent.append((peer_id, h))
+        return True if send is None else send(peer_id, h)
+
+    pool = BlockPool(
+        start,
+        _send,
+        clock=clock,
+        rng=random.Random(0),
+        config=config or PoolConfig(**CFG),
+    )
+    pool._sent = sent  # test-side tap
+    return pool
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    bstats.reset()
+    yield
+    bstats.reset()
+
+
+class TestAdaptiveTimeout:
+    def test_flat_timeout_before_any_rtt_sample(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100)
+        assert pool._peer_timeout(pool.peers["p1"]) == REQUEST_TIMEOUT
+
+    def test_status_rtt_seeds_ewma_once(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=0.8)
+        assert pool.peers["p1"].rtt_ewma == 0.8
+        # a later (slower) status round trip must not clobber real samples
+        pool.set_peer_range("p1", 1, 120, rtt=9.0)
+        assert pool.peers["p1"].rtt_ewma == 0.8
+
+    def test_timeout_is_clamped_ewma_multiple(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=1.5)
+        assert pool._peer_timeout(pool.peers["p1"]) == pytest.approx(6.0)
+        pool.peers["p1"].rtt_ewma = 0.1  # floor binds
+        assert pool._peer_timeout(pool.peers["p1"]) == pytest.approx(2.0)
+        pool.peers["p1"].rtt_ewma = 100.0  # cap binds
+        assert pool._peer_timeout(pool.peers["p1"]) == pytest.approx(30.0)
+
+    def test_ewma_tracks_answered_requests(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100)
+        pool.make_next_requests()
+        clock.advance(1.0)
+        h0 = pool._sent[0][1]
+        assert pool.add_block("p1", _Block(h0))
+        assert pool.peers["p1"].rtt_ewma == pytest.approx(1.0)
+        clock.advance(2.0)  # second answer took 3.0s total in flight
+        h1 = pool._sent[1][1]
+        assert pool.add_block("p1", _Block(h1))
+        # alpha=0.3: 0.3 * 3.0 + 0.7 * 1.0
+        assert pool.peers["p1"].rtt_ewma == pytest.approx(1.6)
+
+    def test_expired_request_reassigns(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=1.0)  # timeout = 4.0
+        pool.make_next_requests()
+        n0 = len(pool._sent)
+        assert n0 == min(REQUEST_WINDOW, PEER_PENDING_CAP)
+        clock.advance(3.9)
+        pool.make_next_requests()
+        assert bstats.snapshot()["timeouts"] == 0
+        clock.advance(0.2)  # now past 4.0
+        pool.make_next_requests()
+        s = bstats.snapshot()
+        assert s["timeouts"] == n0
+        assert len(pool._sent) > n0  # re-requested
+
+
+class TestStrikeBans:
+    def test_ban_only_after_consecutive_timeout_scans(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=1.0)
+        for scan in range(1, 4):
+            pool.make_next_requests()
+            clock.advance(4.1)
+            if scan < 3:
+                pool.make_next_requests()  # expiry scan = one strike
+                assert pool.peers["p1"].timeout_strikes == scan
+                assert bstats.snapshot()["bans"] == 0
+        pool.make_next_requests()  # third consecutive strike -> ban
+        s = bstats.snapshot()
+        assert s["bans"] == 1
+        assert pool.peers["p1"].banned_until > clock.t
+        assert pool.peers["p1"].timeout_strikes == 0  # reset by the ban
+
+    def test_served_block_resets_strikes(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=1.0)
+        pool.make_next_requests()
+        clock.advance(4.1)
+        pool.make_next_requests()
+        assert pool.peers["p1"].timeout_strikes == 1
+        h = pool._sent[-1][1]
+        clock.advance(0.5)
+        assert pool.add_block("p1", _Block(h))
+        assert pool.peers["p1"].timeout_strikes == 0
+
+    def test_ban_backoff_doubles_to_cap(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100)
+        pd = pool.peers["p1"]
+        expected = [2.0, 4.0, 8.0, 16.0, 16.0]  # base 2.0, cap 16.0
+        for i, dur in enumerate(expected):
+            pool.ban_peer("p1")
+            assert pd.ban_count == i + 1
+            assert pd.banned_until == pytest.approx(clock.t + dur)
+            clock.advance(dur + 0.1)  # expire before the next offence
+
+    def test_same_incident_ban_does_not_escalate(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100)
+        pd = pool.peers["p1"]
+        pool.ban_peer("p1")
+        until = pd.banned_until
+        # cached bad blocks surfacing while the ban runs: same incident
+        pool.ban_peer("p1")
+        pool.ban_peer("p1")
+        assert pd.ban_count == 1
+        assert pd.banned_until == until
+        assert bstats.snapshot()["bans"] == 1
+
+    def test_redo_bans_the_sender(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100)
+        pool.make_next_requests()
+        h = pool._sent[0][1]
+        assert pool.add_block("p1", _Block(h))
+        assert pool.redo_request(h) == "p1"
+        s = bstats.snapshot()
+        assert s["redos"] == 1 and s["bans"] == 1
+        assert pool.peers["p1"].banned_until > clock.t
+
+
+class TestHalfOpenProbe:
+    def _banned_pool(self, clock):
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=1.0)
+        pool.ban_peer("p1")
+        return pool
+
+    def test_expired_ban_yields_exactly_one_probe(self):
+        clock = FakeClock()
+        pool = self._banned_pool(clock)
+        pool.make_next_requests()
+        assert not pool.requests  # banned: nothing assigned
+        clock.advance(2.1)  # ban (base 2.0) expires -> half-open
+        pool.make_next_requests()
+        probes = [r for r in pool.requests.values() if r.probe]
+        assert len(pool.requests) == 1 and len(probes) == 1
+        assert pool.peers["p1"].probe_inflight
+        assert bstats.snapshot()["probes"] == 1
+        # while the probe is out the peer gets nothing else
+        pool.make_next_requests()
+        assert len(pool.requests) == 1
+
+    def test_probe_answered_readmits_at_full_share(self):
+        clock = FakeClock()
+        pool = self._banned_pool(clock)
+        clock.advance(2.1)
+        pool.make_next_requests()
+        (h,) = list(pool.requests)
+        clock.advance(0.5)
+        assert pool.add_block("p1", _Block(h))
+        pd = pool.peers["p1"]
+        assert pd.ban_count == 0 and not pd.probe_inflight
+        assert bstats.snapshot()["probe_passes"] == 1
+        pool.make_next_requests()  # full window share again
+        assert len(pool.requests) == min(REQUEST_WINDOW, PEER_PENDING_CAP) + 1
+
+    def test_probe_timeout_rebans_at_next_level(self):
+        clock = FakeClock()
+        pool = self._banned_pool(clock)
+        clock.advance(2.1)
+        pool.make_next_requests()  # probe out (timeout = 4.0 off ewma 1.0)
+        clock.advance(4.1)
+        pool.make_next_requests()  # probe expired -> failed re-admission
+        pd = pool.peers["p1"]
+        assert pd.ban_count == 2
+        assert pd.banned_until == pytest.approx(clock.t + 4.0)  # 2.0 * 2
+        assert bstats.snapshot()["bans"] == 2
+
+
+class TestStallSwitch:
+    def test_frontier_moves_to_fastest_peer(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        # both only advertise the frontier height, so the other peer has
+        # window share left for the switch to land on
+        pool.set_peer_range("slow", 1, 1, rtt=3.0)
+        pool.set_peer_range("fast", 1, 1, rtt=0.5)
+        pool.make_next_requests()
+        owner = pool.requests[pool.height].peer_id
+        other = "fast" if owner == "slow" else "slow"
+        # frontier quiet past the stall window, request still outstanding
+        clock.advance(5.1)
+        pool._progress_t = clock.t - 5.2
+        # (keep the frontier request un-expired for the switch to matter)
+        pool.requests[pool.height].sent_at = clock.t - 0.5
+        pool.make_next_requests()
+        assert pool.requests[pool.height].peer_id == other
+        assert bstats.snapshot()["stall_switches"] == 1
+
+
+class TestPendingAccounting:
+    def test_num_pending_never_negative(self):
+        clock = FakeClock()
+        pool = make_pool(clock)
+        pool.set_peer_range("p1", 1, 100, rtt=1.0)
+        pool.make_next_requests()
+        pd = pool.peers["p1"]
+        h = pool._sent[0][1]
+        assert pool.add_block("p1", _Block(h))
+        pool.no_block("p1", h)  # stale no-block after the block: no-op
+        assert pd.num_pending >= 0
+        clock.advance(4.1)
+        pool.make_next_requests()  # everything else expires
+        assert pd.num_pending >= 0
+        pool.no_block("p1", 10_000)  # for a height never requested
+        assert pd.num_pending >= 0
+
+    def test_send_failure_unwinds_the_request(self):
+        clock = FakeClock()
+        fail_all = {"on": True}
+        pool = make_pool(
+            clock, send=lambda p, h: not fail_all["on"]
+        )
+        pool.set_peer_range("p1", 1, 100)
+        pool.make_next_requests()
+        assert not pool.requests  # every send failed and was unwound
+        assert pool.peers["p1"].num_pending == 0
+        assert bstats.snapshot()["send_failures"] > 0
+        fail_all["on"] = False
+        pool.make_next_requests()
+        assert len(pool.requests) == min(REQUEST_WINDOW, PEER_PENDING_CAP)
+
+
+class TestKillSwitch:
+    def test_legacy_flat_timeout_and_flat_ban(self):
+        clock = FakeClock()
+        cfg = PoolConfig(**{**CFG, "adaptive": False})
+        pool = make_pool(clock, config=cfg)
+        pool.set_peer_range("p1", 1, 100, rtt=1.0)
+        # adaptive state is ignored: flat 15 s even with an EWMA
+        assert pool._peer_timeout(pool.peers["p1"]) == REQUEST_TIMEOUT
+        pool.make_next_requests()
+        clock.advance(REQUEST_TIMEOUT + 0.1)
+        pool.make_next_requests()  # legacy: any timeout scan bans flat 30 s
+        pd = pool.peers["p1"]
+        assert pd.banned_until == pytest.approx(clock.t + 30.0)
+        assert pd.ban_count == 0  # no backoff bookkeeping in legacy mode
+        clock.advance(30.1)
+        pool.make_next_requests()  # re-admitted at FULL share, no probe
+        assert bstats.snapshot()["probes"] == 0
+        assert len(pool.requests) == min(REQUEST_WINDOW, PEER_PENDING_CAP)
+
+    def test_from_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_BSYNC_ADAPTIVE", "0")
+        assert PoolConfig.from_env().adaptive is False
+        monkeypatch.setenv("COMETBFT_TPU_BSYNC_ADAPTIVE", "1")
+        assert PoolConfig.from_env().adaptive is True

@@ -332,3 +332,80 @@ def test_tx_prove_roundtrip(node):
     )
     assert int(ts["total_count"]) == 1
     assert ts["txs"][0]["proof"]["root_hash"] == pj["root_hash"]
+
+
+def test_full_disk_wal_fail_stop_e2e(node):
+    """Full-disk e2e (ROADMAP 6(a)): diskguard injects ENOSPC on the live
+    node's consensus WAL (``data/cs.wal/wal``) — the node fail-stops
+    before voting on unpersisted state, ``/health`` flips to HTTP 503 for
+    liveness probes, and the black-box journal decodes to a clean
+    postmortem attributing the halt to ``disk_fatal`` on the wal surface."""
+    import errno
+
+    from cometbft_tpu.libs import diskguard, storage_stats
+
+    port = node.rpc_server.bound_port
+    _wait_height(node, 2)
+    url = f"http://127.0.0.1:{port}/health"
+    with urllib.request.urlopen(url, timeout=20) as resp:
+        assert resp.status == 200
+
+    plan = diskguard.FaultPlan()
+    plan.add(
+        surface="wal",
+        path_substr="cs.wal",
+        kind=diskguard.KIND_ERRNO,
+        err=errno.ENOSPC,
+    )
+    prev = diskguard.set_fault_plan(plan)
+    try:
+        # the next WAL append hits the full disk: consensus halts itself
+        # (fail-stop, never equivocate) within a couple of block times
+        deadline = time.monotonic() + 30
+        cs = node.consensus
+        while time.monotonic() < deadline:
+            if cs.storage_fatal_err is not None:
+                break
+            time.sleep(0.05)
+        err = cs.storage_fatal_err
+        assert err is not None, "node kept running on a full disk"
+        assert err.surface == "wal"
+        assert err.io_errno == errno.ENOSPC
+
+        # the height is frozen: no commits after the halt
+        h = node.block_store.height()
+        time.sleep(0.5)
+        assert node.block_store.height() == h
+
+        # liveness probe: GET /health is now 503 with a typed error,
+        # served by the still-running RPC listener
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=20)
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read())
+        assert "storage" in doc["error"]["message"]
+
+        # forensics survive the halt: the journal (a DEGRADE surface —
+        # untouched by the wal rule) decodes to a postmortem pinning the
+        # fail-stop on the wal surface with the injected errno
+        bb_dir = node._blackbox.dir
+
+        node.stop()
+        from cometbft_tpu.libs import blackbox
+
+        report = blackbox.postmortem_report(bb_dir)
+        assert report["anomaly_counts"].get("disk_fatal", 0) >= 1, report[
+            "anomaly_counts"
+        ]
+        fatal = [
+            a for a in report["anomalies"] if a.get("kind") == "disk_fatal"
+        ]
+        assert fatal, report["anomalies"]
+        attrs = fatal[-1].get("attrs") or {}
+        assert attrs.get("surface") == "wal"
+        assert attrs.get("errno") == errno.ENOSPC
+    finally:
+        # both are process-global: a leaked plan or a leaked fatal would
+        # 503 every later test's health check
+        diskguard.set_fault_plan(prev)
+        storage_stats.reset()

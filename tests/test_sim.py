@@ -1273,3 +1273,80 @@ class TestDiskFaultScenarios:
         assert res.fail_stopped == []          # nobody halted
         assert all(h >= res.target_height for h in res.heights)
         assert res.storage == {}               # guard fully bypassed
+
+
+class TestBlocksyncScenarios:
+    """Deterministic blocksync under WAN-grade faults (blocksync-storm /
+    wan-catchup): a late joiner catches 40+ heights through lossy
+    bandwidth-shaped links while the adaptive pool bans, probes and
+    re-admits misbehaving helpers."""
+
+    def test_blocksync_storm_joiner_survives_faults(self, tmp_path):
+        res = run_scenario(
+            "blocksync-storm", 7, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"cluster stalled: {res.heights}"
+        assert not res.violations
+        # the joiner caught the full catchup span through the storm
+        assert res.bsync.get("heights_synced", 0) >= 40, res.bsync
+        # every leg of the fault envelope actually fired: timeouts on
+        # dropped replies, a strike ban on the forger, the half-open
+        # probe, and a re-admission after the probe answered
+        assert res.bsync["timeouts"] >= 1, res.bsync
+        assert res.bsync["bans"] >= 1, res.bsync
+        assert res.bsync["probes"] >= 1, res.bsync
+        assert res.bsync["probe_passes"] >= 1, res.bsync
+        assert res.bsync["redos"] >= 1, res.bsync      # forged block redone
+        # the crash-restart leg: the joiner died mid-catchup and resumed
+        assert any("crashed mid-catchup" in line for line in res.trace)
+        # ban -> probe -> re-admission is narrated in the shared trace
+        assert any("blocksync peer banned" in line for line in res.trace)
+        assert any("blocksync half-open probe" in line for line in res.trace)
+        assert any(
+            "probe passed, peer re-admitted" in line for line in res.trace
+        )
+        # the joiner's completion line carries the fused-prefetch budget
+        done = [
+            l for l in res.trace
+            if "bsync node" in l and "complete h=" in l
+        ]
+        assert done, res.trace[-20:]
+        assert "dispatches=" in done[-1]
+
+    def test_wan_catchup_cross_region_through_partition(self, tmp_path):
+        res = run_scenario(
+            "wan-catchup", 7, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached, f"cluster stalled: {res.heights}"
+        assert not res.violations
+        # the joiner synced cross-region despite the mid-sync partition
+        assert res.bsync.get("heights_synced", 0) >= 40, res.bsync
+        assert any("complete h=" in line for line in res.trace)
+
+    def test_blocksync_kill_switch_disables_adaptive(
+        self, tmp_path, monkeypatch
+    ):
+        """COMETBFT_TPU_BSYNC_ADAPTIVE=0: fixed 15 s timeouts, flat bans,
+        no half-open probes — and the catchup still completes.  (Seed 3:
+        under flat 15 s timeouts some seeds leave the joiner mid-sync
+        when the scenario window closes; seed 3 finishes inside it.)"""
+        monkeypatch.setenv("COMETBFT_TPU_BSYNC_ADAPTIVE", "0")
+        res = run_scenario(
+            "blocksync-storm", 3, root=tmp_path, raise_on_violation=True
+        )
+        assert res.reached and not res.violations
+        assert res.bsync.get("heights_synced", 0) >= 40, res.bsync
+        assert res.bsync["probes"] == 0, res.bsync     # no half-open plane
+        assert res.bsync["probe_passes"] == 0, res.bsync
+
+    @pytest.mark.slow
+    def test_blocksync_scenarios_deterministic(self, tmp_path):
+        """Same seed, twice: byte-identical traces and pool counters.
+        (Slow lane: doubles a whole scenario run — the PR-1/PR-3
+        precedent.)"""
+        for name in ("blocksync-storm", "wan-catchup"):
+            a = run_scenario(name, 17, root=tmp_path / (name + "-a"))
+            b = run_scenario(name, 17, root=tmp_path / (name + "-b"))
+            assert a.trace == b.trace, name
+            assert a.heights == b.heights, name
+            assert a.bsync == b.bsync, (name, a.bsync, b.bsync)
